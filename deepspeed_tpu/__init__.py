@@ -1,0 +1,121 @@
+"""deepspeed_tpu — a TPU-native training & inference framework with the
+capability surface of DeepSpeed (reference: deepspeed/__init__.py), built on
+JAX/XLA/Pallas: ZeRO as sharding policy, pipeline/tensor/sequence/expert
+parallelism over a named device mesh, fused Pallas kernels for the hot ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu import comm
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import MeshTopology, ParallelDims
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.version import __version__, version
+
+dist = comm  # reference exposes deepspeed.comm as dist
+
+
+def initialize(args=None,
+               model: Any = None,
+               optimizer: Any = None,
+               model_parameters: Any = None,
+               training_data: Any = None,
+               lr_scheduler: Any = None,
+               distributed_port: int = 29500,
+               mpu: Any = None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn: Optional[Callable] = None,
+               config: Any = None,
+               config_params: Any = None,
+               loss_fn: Optional[Callable] = None,
+               topology: Optional[MeshTopology] = None,
+               base_param_specs: Any = None,
+               batch_spec: Any = None) -> Tuple:
+    """Build the training engine (reference: deepspeed/__init__.py:64).
+
+    Returns ``(engine, optimizer, dataloader, lr_scheduler)`` exactly like the
+    reference. ``model`` is a flax Module / (init_fn, apply_fn) pair;
+    ``model_parameters`` may be a param pytree (host or device) — if omitted,
+    parameters are initialised *sharded* on first forward (the ``zero.Init``
+    behaviour). ``mpu``/``topology`` selects the mesh; default is pure data
+    parallel over all devices.
+    """
+    comm.init_distributed(dist_init_required=dist_init_required,
+                          distributed_port=distributed_port)
+
+    cfg = config if config is not None else config_params
+    if cfg is None and args is not None and hasattr(args, "deepspeed_config") \
+            and args.deepspeed_config is not None:
+        cfg = args.deepspeed_config
+    if cfg is None:
+        raise ValueError("DeepSpeed config required (config= or "
+                         "args.deepspeed_config)")
+
+    if topology is None and mpu is not None and isinstance(mpu, MeshTopology):
+        topology = mpu
+
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+
+    if isinstance(model, PipelineModule):
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+
+        engine = PipelineEngine(model=model, config=cfg,
+                                model_parameters=model_parameters,
+                                loss_fn=loss_fn, topology=topology,
+                                base_param_specs=base_param_specs,
+                                batch_spec=batch_spec,
+                                lr_scheduler=lr_scheduler)
+    else:
+        engine = DeepSpeedEngine(model=model, config=cfg,
+                                 model_parameters=model_parameters,
+                                 loss_fn=loss_fn, topology=topology,
+                                 base_param_specs=base_param_specs,
+                                 batch_spec=batch_spec,
+                                 lr_scheduler=lr_scheduler)
+
+    dataloader = None
+    if training_data is not None:
+        dataloader = DeepSpeedDataLoader(
+            training_data,
+            batch_size=engine.config.train_micro_batch_size_per_gpu *
+            engine.dp_world_size,
+            collate_fn=collate_fn,
+            drop_last=engine.config.dataloader_drop_last)
+
+    return engine, engine.optimizer, dataloader, engine.lr_scheduler
+
+
+def init_inference(model: Any = None, config: Any = None, **kwargs):
+    """Inference engine entry (reference: deepspeed/__init__.py:269)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    return InferenceEngine(model=model, config=config, **kwargs)
+
+
+def add_config_arguments(parser):
+    """Inject --deepspeed / --deepspeed_config argparse flags
+    (reference deepspeed/__init__.py:246)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeed json configuration file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse_suppress())
+    return parser
+
+
+def argparse_suppress():
+    import argparse
+
+    return argparse.SUPPRESS
+
+
+def init_distributed(**kwargs):
+    return comm.init_distributed(**kwargs)

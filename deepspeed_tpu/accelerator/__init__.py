@@ -1,0 +1,16 @@
+from deepspeed_tpu.accelerator.abstract_accelerator import Accelerator
+from deepspeed_tpu.accelerator.real_accelerator import (
+    get_accelerator,
+    is_current_accelerator_supported,
+    set_accelerator,
+)
+from deepspeed_tpu.accelerator.tpu_accelerator import CpuAccelerator, TpuAccelerator
+
+__all__ = [
+    "Accelerator",
+    "TpuAccelerator",
+    "CpuAccelerator",
+    "get_accelerator",
+    "set_accelerator",
+    "is_current_accelerator_supported",
+]

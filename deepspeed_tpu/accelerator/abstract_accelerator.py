@@ -1,0 +1,149 @@
+"""Abstract accelerator interface.
+
+TPU-native analogue of the reference accelerator abstraction
+(reference: accelerator/abstract_accelerator.py:10 ``DeepSpeedAccelerator``).
+Every device touch in the framework goes through ``get_accelerator()`` so the
+same code runs on a real TPU backend or on the virtual N-device CPU mesh used
+in tests.
+
+Unlike the torch original (streams/events/RNG state mutation), the JAX
+execution model is functional and async-by-default, so the surface here is
+smaller: device enumeration, memory introspection, dtype support, RNG
+construction, and the communication-backend name that the comm layer uses to
+pick its implementation.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Optional
+
+
+class Accelerator(abc.ABC):
+    """Base class for accelerator backends (TPU / CPU-sim)."""
+
+    _name: str = "abstract"
+    _communication_backend: str = "xla"
+
+    # ------------------------------------------------------------------ #
+    # Identity
+    # ------------------------------------------------------------------ #
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return self._name
+        return f"{self._name}:{device_index}"
+
+    @abc.abstractmethod
+    def devices(self) -> List[Any]:
+        """All addressable + global devices visible to this process."""
+
+    @abc.abstractmethod
+    def local_devices(self) -> List[Any]:
+        """Devices addressable by this process."""
+
+    def device_count(self) -> int:
+        return len(self.devices())
+
+    def local_device_count(self) -> int:
+        return len(self.local_devices())
+
+    @abc.abstractmethod
+    def current_device(self) -> Any:
+        """Default device for this process."""
+
+    def current_device_name(self) -> str:
+        return self.device_name(0)
+
+    # ------------------------------------------------------------------ #
+    # Execution
+    # ------------------------------------------------------------------ #
+    def synchronize(self, arrays: Any = None) -> None:
+        """Block until outstanding async work is complete.
+
+        JAX dispatch is async; passing the arrays to wait on is preferred
+        (``jax.block_until_ready``); with no arguments this is a full-device
+        sync barrier.
+        """
+        import jax
+
+        if arrays is not None:
+            jax.block_until_ready(arrays)
+        else:
+            # Effectful barrier: tiny computation forced to completion.
+            jax.block_until_ready(jax.device_put(0, self.current_device()))
+
+    # ------------------------------------------------------------------ #
+    # RNG — functional (returns keys rather than mutating global state)
+    # ------------------------------------------------------------------ #
+    def rng_key(self, seed: int):
+        import jax
+
+        return jax.random.key(seed)
+
+    # ------------------------------------------------------------------ #
+    # Memory
+    # ------------------------------------------------------------------ #
+    def memory_stats(self, device: Any = None) -> dict:
+        dev = device if device is not None else self.current_device()
+        try:
+            stats = dev.memory_stats()
+            return dict(stats) if stats else {}
+        except Exception:  # pragma: no cover - backend without stats
+            return {}
+
+    def memory_allocated(self, device: Any = None) -> int:
+        return int(self.memory_stats(device).get("bytes_in_use", 0))
+
+    def total_memory(self, device: Any = None) -> int:
+        return int(self.memory_stats(device).get("bytes_limit", 0))
+
+    def available_memory(self, device: Any = None) -> int:
+        stats = self.memory_stats(device)
+        return int(stats.get("bytes_limit", 0)) - int(stats.get("bytes_in_use", 0))
+
+    # ------------------------------------------------------------------ #
+    # Capability flags
+    # ------------------------------------------------------------------ #
+    @abc.abstractmethod
+    def is_available(self) -> bool:
+        ...
+
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def supported_dtypes(self) -> list:
+        import jax.numpy as jnp
+
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    def preferred_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+
+    # ------------------------------------------------------------------ #
+    # Communication
+    # ------------------------------------------------------------------ #
+    def communication_backend_name(self) -> str:
+        """Name of the comm backend the comm facade should construct.
+
+        ``xla`` = jax.lax collectives over named mesh axes (ICI/DCN routing
+        is decided by the compiler from the mesh's device assignment).
+        """
+        return self._communication_backend
+
+    # ------------------------------------------------------------------ #
+    # Op resolution (op_builder analogue)
+    # ------------------------------------------------------------------ #
+    def create_op_builder(self, name: str):
+        from deepspeed_tpu.ops.op_builder import get_op_builder
+
+        return get_op_builder(name, accelerator=self)
+
+    def on_accelerator(self, array: Any) -> bool:
+        import jax
+
+        return isinstance(array, jax.Array)

@@ -1,0 +1,7 @@
+from deepspeed_tpu.checkpoint.engine import (
+    CheckpointEngine,
+    load_engine_state,
+    save_engine_state,
+)
+
+__all__ = ["CheckpointEngine", "save_engine_state", "load_engine_state"]

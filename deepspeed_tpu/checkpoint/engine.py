@@ -1,0 +1,156 @@
+"""Checkpoint save/load (reference: runtime/checkpoint_engine/
+checkpoint_engine.py:9 pluggable engines + runtime/engine.py:3021
+``save_checkpoint`` / :2672 ``load_checkpoint``).
+
+Directory layout mirrors the reference so tooling expectations transfer::
+
+    <save_dir>/<tag>/mp_rank_00_model_states.npz     # fp32 master weights
+    <save_dir>/<tag>/zero_pp_rank_0_mp_rank_00_optim_states.npz
+    <save_dir>/<tag>/client_state.json
+    <save_dir>/latest                                 # tag pointer
+
+Arrays are gathered to host as numpy (single-controller; multi-host uses
+process-0 consolidation via global device_get). The pluggable
+``CheckpointEngine`` interface matches the reference so an async/Nebula-style
+engine can swap in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.tensors import flat_dict_to_tree, tree_to_flat_dict
+
+
+class CheckpointEngine:
+    """reference: runtime/checkpoint_engine/checkpoint_engine.py:9."""
+
+    def __init__(self, config_params=None):
+        self.config = config_params
+
+    def create(self, tag: str) -> None:
+        log_dist(f"Saving checkpoint tag={tag}", ranks=[0])
+
+    def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
+        np.savez(path, **state_dict)
+
+    def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
+        with np.load(path, allow_pickle=False) as data:
+            return {k: data[k] for k in data.files}
+
+    def commit(self, tag: str) -> bool:
+        return True
+
+
+def _to_numpy_flat(tree) -> Dict[str, np.ndarray]:
+    host = jax.device_get(tree)
+    return {k: np.asarray(v) for k, v in tree_to_flat_dict(host).items()}
+
+
+def save_engine_state(engine, save_dir: str, tag: str,
+                      client_state: Dict[str, Any],
+                      save_latest: bool = True,
+                      checkpoint_engine: Optional[CheckpointEngine] = None) -> str:
+    ce = checkpoint_engine or CheckpointEngine()
+    path = os.path.join(save_dir, str(tag))
+    os.makedirs(path, exist_ok=True)
+    ce.create(tag)
+
+    state = engine.state
+    model_flat = _to_numpy_flat(state["master"])
+    ce.save(model_flat, os.path.join(path, "mp_rank_00_model_states.npz"))
+
+    optim = {
+        "opt": state["opt"],
+        "acc_grads": state["acc_grads"],
+    }
+    optim_flat = _to_numpy_flat(optim)
+    optim_flat["__step__"] = np.asarray(jax.device_get(state["step"]))
+    optim_flat["__opt_step__"] = np.asarray(jax.device_get(state["opt_step"]))
+    optim_flat["__loss_scale__"] = np.asarray(jax.device_get(state["loss_scale"]))
+    optim_flat["__good_steps__"] = np.asarray(jax.device_get(state["good_steps"]))
+    ce.save(optim_flat,
+            os.path.join(path, "zero_pp_rank_0_mp_rank_00_optim_states.npz"))
+
+    with open(os.path.join(path, "client_state.json"), "w") as f:
+        json.dump(client_state, f, indent=2, default=str)
+
+    if save_latest:
+        with open(os.path.join(save_dir, "latest"), "w") as f:
+            f.write(str(tag))
+    ce.commit(tag)
+    return path
+
+
+def load_engine_state(engine, load_dir: str, tag: Optional[str] = None,
+                      load_optimizer_states: bool = True,
+                      checkpoint_engine: Optional[CheckpointEngine] = None
+                      ) -> Tuple[Optional[str], Dict[str, Any]]:
+    ce = checkpoint_engine or CheckpointEngine()
+    if tag is None:
+        latest = os.path.join(load_dir, "latest")
+        if not os.path.exists(latest):
+            logger.warning(f"no 'latest' file in {load_dir}; nothing loaded")
+            return None, {}
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = os.path.join(load_dir, str(tag))
+    model_file = os.path.join(path, "mp_rank_00_model_states.npz")
+    if not os.path.exists(model_file):
+        logger.warning(f"checkpoint {model_file} not found")
+        return None, {}
+
+    if engine.state is None:
+        raise RuntimeError(
+            "engine state must be initialised (run a forward or "
+            "initialize_parameters) before load_checkpoint")
+
+    sh = engine._state_shardings()
+    model_flat = ce.load(model_file)
+    master = flat_dict_to_tree(model_flat, engine.state["master"])
+    master = jax.tree.map(
+        lambda arr, s: jax.device_put(np.asarray(arr), s), master, sh["master"])
+
+    new_state = dict(engine.state)
+    new_state["master"] = master
+    new_state["params"] = jax.jit(
+        lambda m: jax.tree.map(lambda x: x.astype(engine.compute_dtype), m),
+        out_shardings=sh["params"])(master)
+
+    if load_optimizer_states:
+        optim_file = os.path.join(
+            path, "zero_pp_rank_0_mp_rank_00_optim_states.npz")
+        if os.path.exists(optim_file):
+            optim_flat = ce.load(optim_file)
+            scalars = {k: optim_flat.pop(k) for k in list(optim_flat)
+                       if k.startswith("__")}
+            optim = flat_dict_to_tree(
+                optim_flat, {"opt": engine.state["opt"],
+                             "acc_grads": engine.state["acc_grads"]})
+            new_state["opt"] = jax.tree.map(
+                lambda arr, s: jax.device_put(np.asarray(arr), s),
+                optim["opt"], sh["opt"])
+            new_state["acc_grads"] = jax.tree.map(
+                lambda arr, s: jax.device_put(np.asarray(arr), s),
+                optim["acc_grads"], sh["acc_grads"])
+            for name, key in (("step", "__step__"), ("opt_step", "__opt_step__"),
+                              ("loss_scale", "__loss_scale__"),
+                              ("good_steps", "__good_steps__")):
+                if key in scalars:
+                    new_state[name] = jax.device_put(
+                        np.asarray(scalars[key]), sh[name])
+
+    engine.state = new_state
+    client_state: Dict[str, Any] = {}
+    cs_file = os.path.join(path, "client_state.json")
+    if os.path.exists(cs_file):
+        with open(cs_file) as f:
+            client_state = json.load(f)
+    log_dist(f"Loaded checkpoint from {path}", ranks=[0])
+    return path, client_state

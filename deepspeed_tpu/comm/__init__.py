@@ -1,0 +1,32 @@
+from deepspeed_tpu.comm.comm import (
+    all_gather,
+    all_gather_into_tensor,
+    all_reduce,
+    all_to_all_single,
+    axis_index,
+    axis_size,
+    barrier,
+    broadcast,
+    configure,
+    destroy_process_group,
+    get_local_rank,
+    get_rank,
+    get_world_size,
+    inference_all_reduce,
+    init_distributed,
+    is_initialized,
+    log_summary,
+    ppermute,
+    reduce_scatter,
+    reduce_scatter_tensor,
+)
+from deepspeed_tpu.comm.xla_backend import ReduceOp
+
+__all__ = [
+    "ReduceOp", "init_distributed", "is_initialized", "get_rank",
+    "get_world_size", "get_local_rank", "barrier", "destroy_process_group",
+    "all_reduce", "inference_all_reduce", "all_gather",
+    "all_gather_into_tensor", "reduce_scatter", "reduce_scatter_tensor",
+    "all_to_all_single", "broadcast", "ppermute", "axis_index", "axis_size",
+    "configure", "log_summary",
+]

@@ -1,0 +1,62 @@
+"""Communication backend interface (reference: deepspeed/comm/backend.py).
+
+The reference abstracts NCCL/Gloo/oneCCL/HCCL behind ``Backend`` objects; the
+TPU build needs exactly one in-graph backend — XLA collectives over named mesh
+axes — but keeps the interface so alternative backends (e.g. a compressed
+1-bit backend, reference runtime/comm/nccl.py) plug in the same way.
+"""
+
+from __future__ import annotations
+
+import abc
+
+
+class Backend(abc.ABC):
+    def __init__(self, name: str):
+        self.name = name
+        self.initialized = False
+
+    def is_initialized(self) -> bool:
+        return self.initialized
+
+    def init_process_group(self) -> None:
+        self.initialized = True
+
+    def destroy_process_group(self) -> None:
+        self.initialized = False
+
+    # in-graph collectives ------------------------------------------------
+    @abc.abstractmethod
+    def all_reduce(self, tensor, op, group):
+        ...
+
+    @abc.abstractmethod
+    def all_gather(self, tensor, group, axis: int = 0, tiled: bool = False):
+        ...
+
+    @abc.abstractmethod
+    def reduce_scatter(self, tensor, op, group, axis: int = 0):
+        ...
+
+    @abc.abstractmethod
+    def all_to_all(self, tensor, group, split_axis: int, concat_axis: int):
+        ...
+
+    @abc.abstractmethod
+    def broadcast(self, tensor, src, group):
+        ...
+
+    @abc.abstractmethod
+    def permute(self, tensor, perm, group):
+        ...
+
+    # capability flags (reference comm/torch.py capability probing) -------
+    def has_all_gather_into_tensor(self) -> bool:
+        return True
+
+    def has_reduce_scatter_tensor(self) -> bool:
+        return True
+
+    def has_coalescing_manager(self) -> bool:
+        # XLA fuses/coalesces collectives during compilation.
+        return True

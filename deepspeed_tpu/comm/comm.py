@@ -1,0 +1,213 @@
+"""Communication facade (reference: deepspeed/comm/comm.py:222-520 module-level
+collectives, ``init_distributed:604``).
+
+Two tiers, matching how TPU programs are actually structured:
+
+* **In-graph** collectives — ``all_reduce``/``all_gather``/``reduce_scatter``/
+  ``all_to_all_single``/``broadcast``/``send``-style ``ppermute`` — callable
+  inside ``shard_map`` regions where mesh axis names are bound. ``group`` is a
+  mesh-axis tuple or an alias string ("dp", "tp", "sdp", ...; see
+  ``parallel/topology.GROUP_ALIASES``). Every call is recorded by the
+  trace-time comms logger (reference ``timed_op`` comm/comm.py:101).
+
+* **Host-level** process coordination — ``init_distributed`` (over
+  ``jax.distributed``), ``get_rank``/``get_world_size`` (process index/count),
+  ``barrier``. These concern multi-host orchestration; device-level
+  communication always goes through the in-graph tier.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.comm.comms_logging import get_comms_logger
+from deepspeed_tpu.comm.xla_backend import ReduceOp, XlaBackend
+from deepspeed_tpu.parallel.topology import resolve_group
+from deepspeed_tpu.utils.logging import logger
+
+_backend: Optional[XlaBackend] = None
+_initialized = False
+
+
+def _get_backend() -> XlaBackend:
+    global _backend
+    if _backend is None:
+        _backend = XlaBackend()
+        _backend.init_process_group()
+    return _backend
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_distributed(dist_backend: str = "xla",
+                     auto_mpi_discovery: bool = True,
+                     distributed_port: int = 29500,
+                     verbose: bool = True,
+                     timeout=None,
+                     init_method: Optional[str] = None,
+                     dist_init_required: Optional[bool] = None,
+                     config=None,
+                     rank: int = -1,
+                     world_size: int = -1) -> None:
+    """Initialise multi-host coordination (reference comm/comm.py:604).
+
+    Single-process (one TPU VM or CPU sim): nothing to rendezvous; the mesh
+    covers all local devices. Multi-host (TPU pod slice): delegates to
+    ``jax.distributed.initialize`` which plays the role of the reference's
+    ``torch.distributed.init_process_group`` NCCL rendezvous.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    coord = os.environ.get("COORDINATOR_ADDRESS") or init_method
+    n_procs = int(os.environ.get("WORLD_SIZE", world_size if world_size > 0 else 1))
+    if coord or n_procs > 1 or dist_init_required:
+        kwargs = {}
+        if coord:
+            kwargs["coordinator_address"] = coord.replace("tcp://", "")
+        if n_procs > 1:
+            kwargs["num_processes"] = n_procs
+        proc_id = int(os.environ.get("RANK", rank if rank >= 0 else 0))
+        if "num_processes" in kwargs:
+            kwargs["process_id"] = proc_id
+        try:
+            jax.distributed.initialize(**kwargs)
+        except Exception as e:  # already initialized or single-host
+            if verbose:
+                logger.warning(f"jax.distributed.initialize skipped: {e}")
+    _get_backend()
+    _initialized = True
+    if verbose:
+        logger.info(
+            f"Initialized comm backend=xla processes={get_world_size()} "
+            f"devices={len(jax.devices())}")
+
+
+def get_rank(group=None) -> int:
+    """Host process index (reference rank == per-process identity)."""
+    import jax
+
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def get_local_rank() -> int:
+    return int(os.environ.get("LOCAL_RANK", 0))
+
+
+def barrier(group=None) -> None:
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
+
+
+def destroy_process_group() -> None:
+    global _initialized
+    _initialized = False
+
+
+# --------------------------------------------------------------------- #
+# In-graph collectives (valid where mesh axis names are bound)
+# --------------------------------------------------------------------- #
+def _log(op_name: str, tensor, group) -> None:
+    lg = get_comms_logger()
+    if lg.enabled:
+        try:
+            nbytes = int(np.prod(tensor.shape)) * tensor.dtype.itemsize
+        except Exception:
+            nbytes = 0
+        lg.append(op_name, nbytes, group=group)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, async_op: bool = False):
+    axes = resolve_group(group)
+    _log("all_reduce", tensor, axes)
+    return _get_backend().all_reduce(tensor, op=op, group=axes)
+
+
+def inference_all_reduce(tensor, group=None):
+    return all_reduce(tensor, op=ReduceOp.SUM, group=group or "tp")
+
+
+def all_gather(tensor, group=None, axis: int = 0, async_op: bool = False):
+    axes = resolve_group(group)
+    _log("all_gather", tensor, axes)
+    return _get_backend().all_gather(tensor, group=axes, axis=axis)
+
+
+# reference names all_gather_into_tensor / allgather_fn
+all_gather_into_tensor = all_gather
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, axis: int = 0,
+                   async_op: bool = False):
+    axes = resolve_group(group)
+    _log("reduce_scatter", tensor, axes)
+    return _get_backend().reduce_scatter(tensor, op=op, group=axes, axis=axis)
+
+
+reduce_scatter_tensor = reduce_scatter
+
+
+def all_to_all_single(tensor, group=None, split_axis: int = 0,
+                      concat_axis: int = 0, async_op: bool = False):
+    axes = resolve_group(group if group is not None else "sp")
+    _log("all_to_all_single", tensor, axes)
+    return _get_backend().all_to_all(tensor, group=axes, split_axis=split_axis,
+                                     concat_axis=concat_axis)
+
+
+def broadcast(tensor, src: int = 0, group=None, async_op: bool = False):
+    axes = resolve_group(group)
+    _log("broadcast", tensor, axes)
+    return _get_backend().broadcast(tensor, src=src, group=axes)
+
+
+def ppermute(tensor, perm: Sequence[Tuple[int, int]], group="pp"):
+    """Point-to-point stage transfer (reference pipe/p2p.py send/recv): on TPU
+    the idiomatic form is a collective-permute over the pipe axis."""
+    axes = resolve_group(group)
+    _log("ppermute", tensor, axes)
+    return _get_backend().permute(tensor, perm, group=axes)
+
+
+def axis_index(group=None):
+    return _get_backend().axis_index(resolve_group(group))
+
+
+def axis_size(group=None) -> int:
+    return _get_backend().axis_size(resolve_group(group))
+
+
+# --------------------------------------------------------------------- #
+# comms logger config (reference comms config + log_summary comm/comm.py:422)
+# --------------------------------------------------------------------- #
+def configure(deepspeed_config=None, enabled=None, prof_all=None, prof_ops=None,
+              verbose=None, debug=None):
+    cfg = getattr(deepspeed_config, "comms_config", None)
+    lg = get_comms_logger()
+    if cfg is not None:
+        lg.configure(enabled=cfg.enabled, verbose=cfg.verbose,
+                     prof_all=cfg.prof_all, prof_ops=cfg.prof_ops,
+                     debug=cfg.debug)
+    lg.configure(enabled=enabled, verbose=verbose, prof_all=prof_all,
+                 prof_ops=prof_ops, debug=debug)
+
+
+def log_summary(show_straggler: bool = False):
+    return get_comms_logger().log_all()

@@ -1,0 +1,101 @@
+"""Communication op logging (reference: deepspeed/utils/comms_logging.py and
+the ``timed_op`` decorator at deepspeed/comm/comm.py:101).
+
+On TPU, collectives execute inside XLA programs, so per-op host timing (the
+reference's CUDA-event approach) is impossible — and would measure the wrong
+thing anyway, since XLA overlaps collectives with compute. Instead the logger
+records every facade collective *at trace time* (op name, message size,
+group), giving an exact communication-volume profile of the compiled program.
+Wall-clock attribution comes from ``jax.profiler`` traces
+(:mod:`deepspeed_tpu.profiling`).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+def convert_size(size_bytes: int) -> str:
+    if size_bytes == 0:
+        return "0B"
+    names = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    return f"{round(size_bytes / p, 2)} {names[i]}"
+
+
+class CommsLogger:
+    """Per-op-name message-size census of traced collectives."""
+
+    def __init__(self, enabled: bool = False, verbose: bool = False,
+                 prof_all: bool = True, prof_ops: Optional[List[str]] = None,
+                 debug: bool = False):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.debug = debug
+        # op_name -> msg_size -> [count, total_bytes]
+        self.comms_dict: Dict[str, Dict[int, List[int]]] = defaultdict(dict)
+
+    def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None,
+                  debug=None):
+        if enabled is not None:
+            self.enabled = enabled
+        if verbose is not None:
+            self.verbose = verbose
+        if prof_all is not None:
+            self.prof_all = prof_all
+        if prof_ops is not None:
+            self.prof_ops = prof_ops
+        if debug is not None:
+            self.debug = debug
+
+    def _should_log(self, op_name: str, log_name: Optional[str]) -> bool:
+        if not self.enabled:
+            return False
+        if self.prof_all:
+            return True
+        return op_name in self.prof_ops or (log_name in self.prof_ops)
+
+    def append(self, op_name: str, msg_size: int, group=None,
+               log_name: Optional[str] = None):
+        if not self._should_log(op_name, log_name):
+            return
+        sizes = self.comms_dict[op_name]
+        if msg_size in sizes:
+            sizes[msg_size][0] += 1
+            sizes[msg_size][1] += msg_size
+        else:
+            sizes[msg_size] = [1, msg_size]
+        if self.verbose:
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.info(
+                f"comm op: {op_name} | msg size: {convert_size(msg_size)} | "
+                f"group: {group}")
+
+    def log_all(self, print_log: bool = True) -> Dict[str, Dict[int, List[int]]]:
+        if print_log:
+            from deepspeed_tpu.utils.logging import logger
+
+            lines = [f"{'Comm. Op':<22}{'Message Size':<16}{'Count':<8}{'Total Bytes':<14}"]
+            for op_name, sizes in sorted(self.comms_dict.items()):
+                for msg_size, (count, total) in sorted(sizes.items()):
+                    lines.append(
+                        f"{op_name:<22}{convert_size(msg_size):<16}{count:<8}"
+                        f"{convert_size(total):<14}")
+            logger.info("Communication volume summary (trace-time):\n" + "\n".join(lines))
+        return dict(self.comms_dict)
+
+    def reset(self):
+        self.comms_dict = defaultdict(dict)
+
+
+_comms_logger = CommsLogger()
+
+
+def get_comms_logger() -> CommsLogger:
+    return _comms_logger

@@ -1,0 +1,13 @@
+"""Inference engine v1 (reference: inference/engine.py:39 InferenceEngine).
+
+Round-1 placeholder: the TP-sharded generate path lands with the inference
+milestone.
+"""
+
+from __future__ import annotations
+
+
+class InferenceEngine:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "InferenceEngine is under construction in this build")

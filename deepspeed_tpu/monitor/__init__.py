@@ -1,0 +1,3 @@
+from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+__all__ = ["MonitorMaster"]

@@ -1,0 +1,113 @@
+"""Monitoring fan-out (reference: monitor/monitor.py:29 ``MonitorMaster`` →
+TensorBoard / WandB / CSV writers)."""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]  # (name, value, step)
+
+
+class Monitor:
+    def __init__(self, config):
+        self.config = config
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, events: List[Event]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter  # cpu torch
+
+                path = os.path.join(config.output_path or "./runs",
+                                    config.job_name)
+                self.writer = SummaryWriter(log_dir=path)
+            except Exception as e:  # pragma: no cover
+                logger.warning(f"tensorboard unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if self.writer is None:
+            return
+        for name, value, step in events:
+            self.writer.add_scalar(name, float(value), int(step))
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.run = None
+        if self.enabled:
+            try:
+                import wandb  # type: ignore
+
+                self.run = wandb.init(project=config.project,
+                                      group=config.group, entity=config.team)
+            except Exception as e:  # pragma: no cover
+                logger.warning(f"wandb unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if self.run is None:
+            return
+        import wandb  # type: ignore
+
+        for name, value, step in events:
+            wandb.log({name: float(value)}, step=int(step))
+
+
+class CSVMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.output_path = None
+        if self.enabled:
+            self.output_path = os.path.join(config.output_path or ".",
+                                            config.job_name)
+            os.makedirs(self.output_path, exist_ok=True)
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.output_path:
+            return
+        for name, value, step in events:
+            fname = os.path.join(self.output_path,
+                                 name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([int(step), float(value)])
+
+
+class MonitorMaster:
+    """Dispatches events to every enabled writer, rank 0 only."""
+
+    def __init__(self, ds_config):
+        self.writers: List[Monitor] = []
+        try:
+            import jax
+
+            rank0 = jax.process_index() == 0
+        except Exception:
+            rank0 = True
+        if rank0:
+            tb = TensorBoardMonitor(ds_config.tensorboard)
+            wb = WandbMonitor(ds_config.wandb)
+            cv = CSVMonitor(ds_config.csv_monitor)
+            self.writers = [m for m in (tb, wb, cv) if m.enabled]
+        self.enabled = bool(self.writers)
+
+    def write_events(self, events: List[Event]) -> None:
+        for w in self.writers:
+            w.write_events(events)

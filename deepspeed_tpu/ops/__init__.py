@@ -1,0 +1,5 @@
+from deepspeed_tpu.ops.op_builder import all_op_names, get_op_builder, op_report
+from deepspeed_tpu.ops.optimizers import get_optimizer, register_optimizer
+
+__all__ = ["get_op_builder", "all_op_names", "op_report", "get_optimizer",
+           "register_optimizer"]

@@ -1,0 +1,102 @@
+"""Op builder registry (reference: op_builder/builder.py:108 ``OpBuilder`` +
+op_builder/all_ops.py registry).
+
+The reference JIT-compiles CUDA extensions per accelerator. Here ops resolve
+to one of three implementation classes, probed in order:
+
+1. **pallas** — a Pallas TPU kernel (falls back on CPU-sim via interpret mode
+   where supported),
+2. **xla** — a jnp/lax composition (XLA fuses it),
+3. **native** — a host-side C++ library loaded via ctypes (CPU offload
+   optimizers, async file I/O), built by ``make`` in ``deepspeed_tpu/csrc``.
+
+``OpBuilder.load()`` returns the op's python callable; ``is_compatible()``
+reports availability — the surface ``ds_report`` prints.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class OpBuilder:
+    NAME = "base"
+
+    def __init__(self, name: Optional[str] = None, accelerator=None):
+        self.name = name or self.NAME
+        self.accelerator = accelerator
+
+    def module_path(self) -> str:
+        raise NotImplementedError
+
+    def attr_name(self) -> Optional[str]:
+        return None
+
+    def is_compatible(self, verbose: bool = False) -> bool:
+        try:
+            self.load()
+            return True
+        except Exception as e:
+            if verbose:
+                logger.warning(f"op {self.name} unavailable: {e}")
+            return False
+
+    def load(self) -> Any:
+        mod = importlib.import_module(self.module_path())
+        attr = self.attr_name()
+        return getattr(mod, attr) if attr else mod
+
+
+class _SimpleBuilder(OpBuilder):
+    def __init__(self, name: str, module: str, attr: Optional[str] = None,
+                 accelerator=None):
+        super().__init__(name, accelerator)
+        self._module = module
+        self._attr = attr
+
+    def module_path(self) -> str:
+        return self._module
+
+    def attr_name(self) -> Optional[str]:
+        return self._attr
+
+
+# name -> (module, attr)  — mirrors op_builder/all_ops.py's registry
+_OP_REGISTRY: Dict[str, tuple] = {
+    "fused_adam": ("deepspeed_tpu.ops.optimizers", "fused_adam"),
+    "fused_lamb": ("deepspeed_tpu.ops.optimizers", "fused_lamb"),
+    "fused_lion": ("deepspeed_tpu.ops.optimizers", "fused_lion"),
+    "cpu_adam": ("deepspeed_tpu.ops.optimizers", "fused_adam"),
+    "cpu_adagrad": ("deepspeed_tpu.ops.optimizers", "adagrad"),
+    "cpu_lion": ("deepspeed_tpu.ops.optimizers", "fused_lion"),
+    "flash_attn": ("deepspeed_tpu.ops.flash_attention", "flash_attention"),
+    "quantizer": ("deepspeed_tpu.ops.quantizer", None),
+    "transformer": ("deepspeed_tpu.ops.transformer", None),
+    "transformer_inference": ("deepspeed_tpu.ops.transformer", None),
+    "async_io": ("deepspeed_tpu.ops.aio", None),
+    "ragged_ops": ("deepspeed_tpu.ops.ragged", None),
+    "sparse_attn": ("deepspeed_tpu.ops.sparse_attention", None),
+    "random_ltd": ("deepspeed_tpu.ops.random_ltd", None),
+    "evoformer_attn": ("deepspeed_tpu.ops.evoformer_attn", None),
+}
+
+
+def get_op_builder(name: str, accelerator=None) -> OpBuilder:
+    if name not in _OP_REGISTRY:
+        raise ValueError(f"unknown op builder '{name}'; "
+                         f"known: {sorted(_OP_REGISTRY)}")
+    module, attr = _OP_REGISTRY[name]
+    return _SimpleBuilder(name, module, attr, accelerator)
+
+
+def all_op_names() -> list:
+    return sorted(_OP_REGISTRY)
+
+
+def op_report() -> Dict[str, bool]:
+    """Availability table (the ``ds_report`` op section)."""
+    return {name: get_op_builder(name).is_compatible()
+            for name in all_op_names()}

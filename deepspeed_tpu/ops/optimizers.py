@@ -1,0 +1,240 @@
+"""Fused optimizers (reference: csrc/adam/multi_tensor_adam.cu ``FusedAdam``,
+csrc/lamb ``FusedLamb``, csrc/lion, csrc/adagrad, runtime/fp16 master-weight
+handling).
+
+Design: each optimizer is a pair of pure functions ``init(master) -> state``
+and ``update(grads, state, master, lr, step) -> (master', state')`` operating
+on whole pytrees. Under ``jit`` XLA fuses the per-parameter elementwise update
+chains into single kernels — the multi-tensor-apply machinery the reference
+needs on CUDA is the compiler's job here. fp32 master weights live next to
+the moments; the engine keeps the bf16/fp16 compute copy.
+
+All state trees inherit the master's sharding (ZeRO stage >= 1 shards master +
+moments over the ZeRO axes via the engine's out_shardings).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class OptimizerDef(NamedTuple):
+    name: str
+    init: Callable[[Any], Any]
+    update: Callable[..., Tuple[Any, Any]]
+    hyperparams: Dict[str, Any]
+
+
+def _tree_zeros_like(tree, dtype=jnp.float32):
+    return jax.tree.map(lambda p: jnp.zeros(p.shape, dtype), tree)
+
+
+# --------------------------------------------------------------------- #
+# Adam / AdamW  (reference csrc/adam/fused_adam_frontend.cpp, cpu_adam_impl)
+# --------------------------------------------------------------------- #
+def fused_adam(lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+               weight_decay: float = 0.0, adam_w_mode: bool = True,
+               bias_correction: bool = True, **_unused) -> OptimizerDef:
+    b1, b2 = betas
+
+    def init(master):
+        return {"m": _tree_zeros_like(master), "v": _tree_zeros_like(master)}
+
+    def update(grads, state, master, lr_t, step):
+        step_f = step.astype(jnp.float32)
+        if bias_correction:
+            c1 = 1.0 - b1 ** step_f
+            c2 = 1.0 - b2 ** step_f
+        else:
+            c1 = c2 = jnp.float32(1.0)
+
+        def upd(g, m, v, p):
+            g = g.astype(jnp.float32)
+            if not adam_w_mode and weight_decay > 0.0:
+                g = g + weight_decay * p
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+            denom = jnp.sqrt(v_new / c2) + eps
+            stepval = (m_new / c1) / denom
+            if adam_w_mode and weight_decay > 0.0:
+                stepval = stepval + weight_decay * p
+            return p - lr_t * stepval, m_new, v_new
+
+        out = jax.tree.map(upd, grads, state["m"], state["v"], master)
+        new_master = jax.tree.map(lambda o: o[0], out,
+                                  is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree.map(lambda o: o[1], out,
+                             is_leaf=lambda x: isinstance(x, tuple))
+        new_v = jax.tree.map(lambda o: o[2], out,
+                             is_leaf=lambda x: isinstance(x, tuple))
+        return new_master, {"m": new_m, "v": new_v}
+
+    return OptimizerDef("adam" if not adam_w_mode else "adamw", init, update,
+                        dict(lr=lr, betas=betas, eps=eps,
+                             weight_decay=weight_decay))
+
+
+# --------------------------------------------------------------------- #
+# LAMB  (reference csrc/lamb/fused_lamb_cuda_kernel.cu — per-tensor trust
+# ratio from ||p|| / ||update||)
+# --------------------------------------------------------------------- #
+def fused_lamb(lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-6,
+               weight_decay: float = 0.0, max_coeff: float = 10.0,
+               min_coeff: float = 0.01, bias_correction: bool = True,
+               **_unused) -> OptimizerDef:
+    b1, b2 = betas
+
+    def init(master):
+        return {"m": _tree_zeros_like(master), "v": _tree_zeros_like(master)}
+
+    def update(grads, state, master, lr_t, step):
+        step_f = step.astype(jnp.float32)
+        c1 = 1.0 - b1 ** step_f if bias_correction else jnp.float32(1.0)
+        c2 = 1.0 - b2 ** step_f if bias_correction else jnp.float32(1.0)
+
+        def upd(g, m, v, p):
+            g = g.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+            upd_dir = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+            if weight_decay > 0.0:
+                upd_dir = upd_dir + weight_decay * p
+            # NOTE: with ZeRO-sharded params these norms are *global* because
+            # the arrays are sharded jax.Arrays — XLA inserts the psum.
+            p_norm = jnp.linalg.norm(p)
+            u_norm = jnp.linalg.norm(upd_dir)
+            trust = jnp.where(
+                (p_norm > 0.0) & (u_norm > 0.0),
+                jnp.clip(p_norm / u_norm, min_coeff, max_coeff), 1.0)
+            return p - lr_t * trust * upd_dir, m_new, v_new
+
+        out = jax.tree.map(upd, grads, state["m"], state["v"], master)
+        is_t = lambda x: isinstance(x, tuple)
+        return (jax.tree.map(lambda o: o[0], out, is_leaf=is_t),
+                {"m": jax.tree.map(lambda o: o[1], out, is_leaf=is_t),
+                 "v": jax.tree.map(lambda o: o[2], out, is_leaf=is_t)})
+
+    return OptimizerDef("lamb", init, update,
+                        dict(lr=lr, betas=betas, eps=eps,
+                             weight_decay=weight_decay))
+
+
+# --------------------------------------------------------------------- #
+# Lion  (reference csrc/lion/fused_lion*)
+# --------------------------------------------------------------------- #
+def fused_lion(lr: float = 1e-4, betas=(0.9, 0.99), weight_decay: float = 0.0,
+               **_unused) -> OptimizerDef:
+    b1, b2 = betas
+
+    def init(master):
+        return {"m": _tree_zeros_like(master)}
+
+    def update(grads, state, master, lr_t, step):
+        del step
+
+        def upd(g, m, p):
+            g = g.astype(jnp.float32)
+            c = b1 * m + (1.0 - b1) * g
+            p_new = p * (1.0 - lr_t * weight_decay) - lr_t * jnp.sign(c)
+            m_new = b2 * m + (1.0 - b2) * g
+            return p_new, m_new
+
+        out = jax.tree.map(upd, grads, state["m"], master)
+        is_t = lambda x: isinstance(x, tuple)
+        return (jax.tree.map(lambda o: o[0], out, is_leaf=is_t),
+                {"m": jax.tree.map(lambda o: o[1], out, is_leaf=is_t)})
+
+    return OptimizerDef("lion", init, update,
+                        dict(lr=lr, betas=betas, weight_decay=weight_decay))
+
+
+# --------------------------------------------------------------------- #
+# SGD (+momentum) and Adagrad (reference csrc/adagrad/cpu_adagrad.cpp)
+# --------------------------------------------------------------------- #
+def sgd(lr: float = 1e-3, momentum: float = 0.0, weight_decay: float = 0.0,
+        nesterov: bool = False, **_unused) -> OptimizerDef:
+    def init(master):
+        if momentum == 0.0:
+            return {}
+        return {"m": _tree_zeros_like(master)}
+
+    def update(grads, state, master, lr_t, step):
+        del step
+
+        if momentum == 0.0:
+            def upd(g, p):
+                g = g.astype(jnp.float32)
+                if weight_decay > 0.0:
+                    g = g + weight_decay * p
+                return p - lr_t * g
+
+            return jax.tree.map(upd, grads, master), state
+
+        def upd(g, m, p):
+            g = g.astype(jnp.float32)
+            if weight_decay > 0.0:
+                g = g + weight_decay * p
+            m_new = momentum * m + g
+            d = g + momentum * m_new if nesterov else m_new
+            return p - lr_t * d, m_new
+
+        out = jax.tree.map(upd, grads, state["m"], master)
+        is_t = lambda x: isinstance(x, tuple)
+        return (jax.tree.map(lambda o: o[0], out, is_leaf=is_t),
+                {"m": jax.tree.map(lambda o: o[1], out, is_leaf=is_t)})
+
+    return OptimizerDef("sgd", init, update, dict(lr=lr, momentum=momentum))
+
+
+def adagrad(lr: float = 1e-2, eps: float = 1e-10, weight_decay: float = 0.0,
+            **_unused) -> OptimizerDef:
+    def init(master):
+        return {"v": _tree_zeros_like(master)}
+
+    def update(grads, state, master, lr_t, step):
+        del step
+
+        def upd(g, v, p):
+            g = g.astype(jnp.float32)
+            if weight_decay > 0.0:
+                g = g + weight_decay * p
+            v_new = v + g * g
+            return p - lr_t * g / (jnp.sqrt(v_new) + eps), v_new
+
+        out = jax.tree.map(upd, grads, state["v"], master)
+        is_t = lambda x: isinstance(x, tuple)
+        return (jax.tree.map(lambda o: o[0], out, is_leaf=is_t),
+                {"v": jax.tree.map(lambda o: o[1], out, is_leaf=is_t)})
+
+    return OptimizerDef("adagrad", init, update, dict(lr=lr, eps=eps))
+
+
+# --------------------------------------------------------------------- #
+# Registry (reference runtime/engine.py:1254 _configure_basic_optimizer)
+# --------------------------------------------------------------------- #
+_REGISTRY: Dict[str, Callable[..., OptimizerDef]] = {
+    "adam": lambda **kw: fused_adam(adam_w_mode=kw.pop("adam_w_mode", False), **kw),
+    "adamw": lambda **kw: fused_adam(adam_w_mode=True, **kw),
+    "fusedadam": lambda **kw: fused_adam(**kw),
+    "lamb": fused_lamb,
+    "fusedlamb": fused_lamb,
+    "lion": fused_lion,
+    "fusedlion": fused_lion,
+    "sgd": sgd,
+    "adagrad": adagrad,
+}
+
+
+def get_optimizer(name: str, params: Dict[str, Any]) -> OptimizerDef:
+    key = name.lower().replace("_", "")
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"Unknown optimizer '{name}'. Available: {sorted(_REGISTRY)}")
+    return _REGISTRY[key](**dict(params))
+
+
+def register_optimizer(name: str, factory: Callable[..., OptimizerDef]) -> None:
+    _REGISTRY[name.lower().replace("_", "")] = factory

@@ -1,0 +1,13 @@
+from deepspeed_tpu.parallel.topology import (
+    GROUP_ALIASES,
+    MESH_AXES,
+    MeshTopology,
+    ParallelDims,
+    resolve_group,
+)
+from deepspeed_tpu.parallel import groups
+
+__all__ = [
+    "MESH_AXES", "GROUP_ALIASES", "MeshTopology", "ParallelDims",
+    "resolve_group", "groups",
+]

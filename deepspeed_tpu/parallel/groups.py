@@ -1,0 +1,124 @@
+"""Process-wide mesh state + group getters (reference: deepspeed/utils/groups.py).
+
+The reference builds one ``ProcessGroup`` per parallelism flavour
+(``_get_data_parallel_group:317``, ``_get_sequence_parallel_group:468``,
+``_create_expert_and_data_parallel:113`` ...). Here a group is a tuple of mesh
+axis names over the singleton :class:`MeshTopology`; the getters return those
+tuples, and ``get_mesh()`` returns the live ``jax.sharding.Mesh``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from deepspeed_tpu.parallel.topology import (
+    GROUP_ALIASES,
+    MESH_AXES,
+    MeshTopology,
+    ParallelDims,
+    resolve_group,
+)
+
+_topology: Optional[MeshTopology] = None
+
+
+def initialize_mesh(
+    pipe_parallel_size: int = 1,
+    data_parallel_size: int = -1,
+    sequence_parallel_size: int = 1,
+    expert_parallel_size: int = 1,
+    model_parallel_size: int = 1,
+    devices=None,
+) -> MeshTopology:
+    """Build (or rebuild) the global mesh topology."""
+    global _topology
+    dims = ParallelDims(
+        pipe=pipe_parallel_size,
+        data=data_parallel_size,
+        seq=sequence_parallel_size,
+        expert=expert_parallel_size,
+        model=model_parallel_size,
+    )
+    _topology = MeshTopology(dims, devices=devices)
+    return _topology
+
+
+def is_initialized() -> bool:
+    return _topology is not None
+
+
+def get_topology() -> MeshTopology:
+    global _topology
+    if _topology is None:
+        # Default: pure data parallel over every visible device.
+        _topology = initialize_mesh()
+    return _topology
+
+
+def get_mesh():
+    return get_topology().mesh
+
+
+def set_topology(topology: MeshTopology) -> None:
+    global _topology
+    _topology = topology
+
+
+def reset() -> None:
+    global _topology
+    _topology = None
+
+
+# --------------------------------------------------------------------- #
+# Reference-named getters: each returns the axis-name tuple ("the group")
+# --------------------------------------------------------------------- #
+def _get_data_parallel_group() -> Tuple[str, ...]:
+    return GROUP_ALIASES["dp"]
+
+
+def _get_sequence_parallel_group() -> Tuple[str, ...]:
+    return GROUP_ALIASES["sp"]
+
+
+def _get_sequence_data_parallel_group() -> Tuple[str, ...]:
+    return GROUP_ALIASES["sdp"]
+
+
+def _get_model_parallel_group() -> Tuple[str, ...]:
+    return GROUP_ALIASES["mp"]
+
+
+def _get_expert_parallel_group() -> Tuple[str, ...]:
+    return GROUP_ALIASES["ep"]
+
+
+def _get_expert_data_parallel_group() -> Tuple[str, ...]:
+    return GROUP_ALIASES["edp"]
+
+
+def _get_pipe_parallel_group() -> Tuple[str, ...]:
+    return GROUP_ALIASES["pp"]
+
+
+def _get_zero_param_group() -> Tuple[str, ...]:
+    return GROUP_ALIASES["zero"]
+
+
+def get_data_parallel_world_size() -> int:
+    return get_topology().data_parallel_size
+
+
+def get_model_parallel_world_size() -> int:
+    return get_topology().model_parallel_size
+
+
+def get_expert_parallel_world_size() -> int:
+    return get_topology().expert_parallel_size
+
+
+def get_sequence_parallel_world_size() -> int:
+    return get_topology().sequence_parallel_size
+
+
+def get_world_size() -> int:
+    return get_topology().world_size

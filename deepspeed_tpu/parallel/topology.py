@@ -1,0 +1,236 @@
+"""Device-mesh topology: the TPU-native process-group layer.
+
+Replaces the reference's rank-arithmetic process groups
+(``deepspeed/utils/groups.py:317-560`` group getters and
+``deepspeed/runtime/pipe/topology.py:12`` ``ProcessTopology`` /
+``:251`` ``PipelineParallelGrid``) with a single named-axis
+``jax.sharding.Mesh``. Where the reference materialises one
+``torch.distributed.ProcessGroup`` per parallelism flavour, here a "group" is
+just a tuple of mesh axis names — XLA lowers collectives over those axes onto
+ICI (intra-slice) or DCN (cross-slice) from the mesh's device assignment.
+
+Canonical axis order (outer → inner):
+
+    ('pipe', 'data', 'seq', 'expert', 'model')
+
+* ``pipe``   — pipeline stages (reference PipelineParallelGrid pipe axis)
+* ``data``   — pure data parallel replicas
+* ``seq``    — Ulysses sequence parallel (reference sequence_parallel group)
+* ``expert`` — expert parallel (reference expert_parallel group)
+* ``model``  — tensor parallel (reference model_parallel group)
+
+Derived groups (tuples of axes):
+
+* batch (data-loader) axes: ``('data', 'expert')`` — each dp replica sees a
+  distinct micro-batch slice; seq ranks share the batch but split the
+  sequence dim.
+* ZeRO / dense-grad axes: ``('data', 'seq', 'expert')`` — matches the
+  reference's use of the *seq_data_parallel* group as the ZeRO partition
+  group (``runtime/engine.py:1125,1509``).
+* expert-data axes: ``('data', 'seq')`` — grad reduction group for expert
+  params (reference ``_reduce_expert_gradients``, engine.py:2406).
+
+``model`` is innermost so TP collectives ride the fastest ICI links; ``pipe``
+is outermost so stage p2p transfers cross the slowest links, mirroring the
+reference's pipe-outer mapping (topology.py axes order ``pipe,data,model``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MESH_AXES: Tuple[str, ...] = ("pipe", "data", "seq", "expert", "model")
+
+# Axis-group aliases accepted anywhere a "group" is taken (comm facade, ZeRO).
+GROUP_ALIASES: Dict[str, Tuple[str, ...]] = {
+    "world": MESH_AXES,
+    "data_parallel": ("data", "expert"),
+    "dp": ("data", "expert"),
+    "seq_data_parallel": ("data", "seq", "expert"),
+    "sdp": ("data", "seq", "expert"),
+    "zero": ("data", "seq", "expert"),
+    "sequence_parallel": ("seq",),
+    "sp": ("seq",),
+    "model_parallel": ("model",),
+    "tensor_parallel": ("model",),
+    "tp": ("model",),
+    "mp": ("model",),
+    "expert_parallel": ("expert",),
+    "ep": ("expert",),
+    "expert_data_parallel": ("data", "seq"),
+    "edp": ("data", "seq"),
+    "pipe_parallel": ("pipe",),
+    "pp": ("pipe",),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelDims:
+    """Degrees of each parallelism flavour. ``data=-1`` infers from devices."""
+
+    pipe: int = 1
+    data: int = -1
+    seq: int = 1
+    expert: int = 1
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> "ParallelDims":
+        fixed = self.pipe * self.seq * self.expert * self.model
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"device count {n_devices} not divisible by "
+                    f"pipe*seq*expert*model={fixed}")
+            data = n_devices // fixed
+        if self.pipe * data * self.seq * self.expert * self.model != n_devices:
+            raise ValueError(
+                f"mesh {self.as_dict()} (data={data}) does not cover "
+                f"{n_devices} devices")
+        return dataclasses.replace(self, data=data)
+
+    def as_dict(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+    def shape(self) -> Tuple[int, ...]:
+        return (self.pipe, self.data, self.seq, self.expert, self.model)
+
+
+class MeshTopology:
+    """A resolved device mesh plus the reference's group/rank algebra.
+
+    Exposes the ``ProcessTopology`` query surface (axis sizes, coordinates,
+    rank filtering) so code written against the reference's topology concepts
+    has a direct analogue, while the real artefact is ``self.mesh`` — the
+    ``jax.sharding.Mesh`` every jit/shard_map in the framework runs under.
+    """
+
+    def __init__(self, dims: ParallelDims, devices: Optional[Sequence[Any]] = None):
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        self.dims = dims.resolve(len(devices))
+        shape = self.dims.shape()
+        # Auto axis types = GSPMD constraint solving: ZeRO relies on XLA
+        # propagating/resolving shardings between the annotated state specs
+        # (the Explicit default would demand manual resolution at every dot).
+        axis_types = (jax.sharding.AxisType.Auto,) * len(MESH_AXES)
+        try:
+            # make_mesh picks an ICI-friendly device assignment on TPU.
+            self.mesh = jax.make_mesh(shape, MESH_AXES, devices=devices,
+                                      axis_types=axis_types)
+        except TypeError:
+            device_array = np.asarray(devices).reshape(shape)
+            self.mesh = Mesh(device_array, MESH_AXES, axis_types=axis_types)
+
+    # ------------------------------------------------------------------ #
+    # Axis algebra
+    # ------------------------------------------------------------------ #
+    @property
+    def world_size(self) -> int:
+        return math.prod(self.dims.shape())
+
+    def get_dim(self, axis: str) -> int:
+        return getattr(self.dims, axis)
+
+    def axis_size(self, axes) -> int:
+        return math.prod(self.get_dim(a) for a in resolve_group(axes))
+
+    @property
+    def data_parallel_size(self) -> int:
+        return self.axis_size("dp")
+
+    @property
+    def zero_partition_size(self) -> int:
+        return self.axis_size("zero")
+
+    @property
+    def model_parallel_size(self) -> int:
+        return self.dims.model
+
+    @property
+    def expert_parallel_size(self) -> int:
+        return self.dims.expert
+
+    @property
+    def sequence_parallel_size(self) -> int:
+        return self.dims.seq
+
+    @property
+    def pipe_parallel_size(self) -> int:
+        return self.dims.pipe
+
+    # ------------------------------------------------------------------ #
+    # ProcessTopology-style rank queries (reference pipe/topology.py:12)
+    # ------------------------------------------------------------------ #
+    def get_axes(self) -> Tuple[str, ...]:
+        return MESH_AXES
+
+    def get_coord(self, rank: int) -> Dict[str, int]:
+        """Rank → named coordinates in the mesh grid."""
+        coords = np.unravel_index(rank, self.dims.shape())
+        return dict(zip(MESH_AXES, (int(c) for c in coords)))
+
+    def get_rank(self, **coords: int) -> int:
+        """Named coordinates → rank (all axes required)."""
+        idx = tuple(coords[a] for a in MESH_AXES)
+        return int(np.ravel_multi_index(idx, self.dims.shape()))
+
+    def filter_match(self, **coords: int) -> List[int]:
+        """All ranks whose coordinates match the given axis values."""
+        ranks = []
+        for r in range(self.world_size):
+            c = self.get_coord(r)
+            if all(c[a] == v for a, v in coords.items()):
+                ranks.append(r)
+        return ranks
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Groups of ranks that communicate along ``axis`` (reference
+        ``ProcessTopology.get_axis_comm_lists``)."""
+        others = [a for a in MESH_AXES if a != axis]
+        lists: List[List[int]] = []
+        seen = set()
+        for r in range(self.world_size):
+            c = self.get_coord(r)
+            key = tuple(c[a] for a in others)
+            if key in seen:
+                continue
+            seen.add(key)
+            group = self.filter_match(**{a: c[a] for a in others})
+            if len(group) > 1 or self.get_dim(axis) == 1:
+                lists.append(group)
+        return lists
+
+    def sharding(self, spec) -> Any:
+        """Convenience: PartitionSpec → NamedSharding on this mesh."""
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, spec)
+
+    def __repr__(self) -> str:
+        return f"MeshTopology({self.dims.as_dict()})"
+
+
+def resolve_group(group) -> Tuple[str, ...]:
+    """Normalise a group designator to a tuple of mesh axis names.
+
+    Accepts: None (→ ZeRO/dense-grad group), an alias string from
+    ``GROUP_ALIASES``, a single axis name, or a tuple of axis names.
+    """
+    if group is None:
+        return GROUP_ALIASES["zero"]
+    if isinstance(group, str):
+        if group in GROUP_ALIASES:
+            return GROUP_ALIASES[group]
+        if group in MESH_AXES:
+            return (group,)
+        raise ValueError(f"unknown group/axis {group!r}")
+    return tuple(group)

@@ -1,0 +1,4 @@
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+__all__ = ["DeepSpeedConfig", "DeepSpeedEngine"]

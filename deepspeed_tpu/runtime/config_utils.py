@@ -1,0 +1,113 @@
+"""Typed config-model base (reference: runtime/config_utils.py:16
+``DeepSpeedConfigModel`` on pydantic).
+
+A dependency-light reimplementation over dataclasses: declarative fields with
+type coercion, unknown-key warnings, deprecated-field forwarding, and
+``new_param``-style migration — the same ergonomics the reference gets from
+its pydantic base, without pinning a pydantic major version.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Type, TypeVar, get_args, get_origin
+
+from deepspeed_tpu.utils.logging import logger
+
+T = TypeVar("T", bound="DeepSpeedConfigModel")
+
+
+def config_field(default=None, *, default_factory=None, deprecated: bool = False,
+                 new_param: Optional[str] = None, aliases: tuple = (), **meta):
+    """Field declaration: supports reference-style ``deprecated`` +
+    ``new_param`` forwarding and accepted key aliases."""
+    metadata = {"deprecated": deprecated, "new_param": new_param,
+                "aliases": aliases, **meta}
+    if default_factory is not None:
+        return dataclasses.field(default_factory=default_factory, metadata=metadata)
+    return dataclasses.field(default=default, metadata=metadata)
+
+
+def _coerce(value: Any, typ: Any) -> Any:
+    origin = get_origin(typ)
+    if value is None:
+        return None
+    if origin is not None:
+        args = get_args(typ)
+        if origin is dict or origin is list or origin is tuple:
+            return value
+        # Optional[X] / Union
+        for a in args:
+            if a is type(None):
+                continue
+            try:
+                return _coerce(value, a)
+            except Exception:
+                continue
+        return value
+    if dataclasses.is_dataclass(typ) and isinstance(value, dict):
+        return typ.from_dict(value)
+    if typ in (int, float, str, bool) and not isinstance(value, typ):
+        if typ is bool and isinstance(value, str):
+            return value.lower() in ("true", "1", "yes", "on")
+        if typ is int and isinstance(value, str) and value.lower() == "auto":
+            return value  # "auto" survives as sentinel
+        try:
+            return typ(value)
+        except (TypeError, ValueError):
+            return value
+    return value
+
+
+@dataclasses.dataclass
+class DeepSpeedConfigModel:
+    """Base for all subsystem configs. Construct with ``from_dict``."""
+
+    @classmethod
+    def from_dict(cls: Type[T], data: Optional[Dict[str, Any]] = None) -> T:
+        import typing
+
+        data = dict(data or {})
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        try:
+            hints = typing.get_type_hints(cls)
+        except Exception:
+            hints = {}
+        alias_map: Dict[str, str] = {}
+        for name, f in fields.items():
+            for alias in f.metadata.get("aliases", ()) if f.metadata else ():
+                alias_map[alias] = name
+
+        kwargs: Dict[str, Any] = {}
+        for key in list(data.keys()):
+            name = alias_map.get(key, key)
+            if name not in fields:
+                logger.warning(f"{cls.__name__}: unknown config key '{key}' ignored")
+                continue
+            f = fields[name]
+            if f.metadata and f.metadata.get("deprecated"):
+                new_param = f.metadata.get("new_param")
+                logger.warning(
+                    f"{cls.__name__}: '{key}' is deprecated"
+                    + (f"; use '{new_param}'" if new_param else ""))
+                if new_param:
+                    data.setdefault(new_param, data[key])
+                    continue
+            kwargs[name] = _coerce(data[key], hints.get(name, Any))
+        obj = cls(**kwargs)
+        obj._validate()
+        return obj
+
+    def _validate(self) -> None:  # override in subclasses
+        pass
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"{type(self).__name__}({self.to_dict()})"
+
+
+def get_scalar_param(param_dict: Dict[str, Any], param_name: str, param_default_value):
+    """Legacy getter-style access (reference runtime/config.py:789)."""
+    return param_dict.get(param_name, param_default_value)

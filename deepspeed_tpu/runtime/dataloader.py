@@ -1,0 +1,103 @@
+"""Data loading (reference: runtime/dataloader.py ``DeepSpeedDataLoader`` +
+``RepeatingLoader``; hookup via engine.deepspeed_io, runtime/engine.py:1680).
+
+Yields *global* micro-batches as host numpy trees; the engine shards them
+over the data-parallel mesh axes on device_put. Supports map-style datasets
+(indexable) and iterables; deterministic shuffling from a seed epoch stream.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class DeepSpeedDataLoader:
+    def __init__(self, dataset: Any, batch_size: int,
+                 collate_fn: Optional[Callable] = None,
+                 shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = True,
+                 data_sampler: Optional[Iterator[int]] = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.data_sampler = data_sampler
+        self.epoch = 0
+        if hasattr(dataset, "__len__"):
+            n = len(dataset)
+            self.len = n // batch_size if drop_last else -(-n // batch_size)
+        else:
+            self.len = None
+
+    def __len__(self) -> int:
+        if self.len is None:
+            raise TypeError("dataset has no length")
+        return self.len
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self):
+        if not hasattr(self.dataset, "__getitem__"):
+            yield from _iter_batches(iter(self.dataset), self.batch_size,
+                                     self.collate_fn, self.drop_last)
+            return
+        n = len(self.dataset)
+        if self.data_sampler is not None:
+            order = list(self.data_sampler)
+        elif self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            order = rng.permutation(n).tolist()
+        else:
+            order = list(range(n))
+        for start in range(0, n, self.batch_size):
+            idx = order[start:start + self.batch_size]
+            if len(idx) < self.batch_size and self.drop_last:
+                return
+            yield self.collate_fn([self.dataset[i] for i in idx])
+
+
+class RepeatingLoader:
+    """reference runtime/dataloader.py RepeatingLoader: wraps any loader into
+    an infinite stream."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(getattr(self.loader, "epoch", 0) + 1)
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def _default_collate(samples: Sequence[Any]):
+    import jax
+
+    first = samples[0]
+    if isinstance(first, np.ndarray) or np.isscalar(first):
+        return np.stack([np.asarray(s) for s in samples])
+    return jax.tree.map(lambda *leaves: np.stack([np.asarray(l) for l in leaves]),
+                        *samples)
+
+
+def _iter_batches(it, batch_size, collate_fn, drop_last):
+    buf = []
+    for sample in it:
+        buf.append(sample)
+        if len(buf) == batch_size:
+            yield collate_fn(buf)
+            buf = []
+    if buf and not drop_last:
+        yield collate_fn(buf)

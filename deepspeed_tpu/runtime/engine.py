@@ -1,0 +1,605 @@
+"""DeepSpeedEngine — the training engine (reference: runtime/engine.py:175).
+
+Keeps the reference's user surface — ``loss = engine(batch)``,
+``engine.backward(loss)``, ``engine.step()``, ``save_checkpoint`` /
+``load_checkpoint``, gradient-accumulation boundaries, dynamic loss scaling —
+re-architected for XLA:
+
+* ``forward`` runs ONE jitted program computing loss *and* gradients
+  (``jax.value_and_grad``); the host-visible fwd/bwd/step split is kept as
+  bookkeeping. Splitting fwd and bwd into separate device programs (the torch
+  way) would double HBM traffic for no benefit under a compiler that already
+  overlaps.
+* ZeRO stages 0-3 are sharding policies (:mod:`deepspeed_tpu.runtime.zero`)
+  applied as jit in/out shardings — XLA inserts the reduce-scatter /
+  all-gather pattern the reference hand-codes (stage_1_and_2.py:998
+  ``average_tensor``, stage3.py:1179 ``__reduce_and_partition_ipg_grads``).
+* fp16 dynamic loss scaling (reference runtime/fp16/loss_scaler.py) runs
+  *inside* the jitted step via ``jnp.where`` — no host sync to test overflow.
+* Gradient clipping is a global-norm clip over sharded grad trees; the norm's
+  cross-shard reduction is inserted by XLA.
+
+State layout (a plain pytree, so the whole engine state is one
+donate-able jit argument)::
+
+    state = {
+      "step":       i32[]   global optimizer steps taken (reference global_steps)
+      "opt_step":   i32[]   successful optimizer steps (bias correction clock)
+      "params":     tree    compute-precision weights (bf16/fp16/fp32)
+      "master":     tree    fp32 master weights          (stage>=1: sharded)
+      "opt":        tree    optimizer moments            (stage>=1: sharded)
+      "acc_grads":  tree    fp32 grad accumulators       (stage>=2: sharded)
+      "loss_scale": f32[]   current loss scale
+      "good_steps": i32[]   consecutive non-overflow steps
+    }
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import GROUP_ALIASES, MeshTopology
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.lr_schedules import LRScheduler, get_lr_schedule_fn
+from deepspeed_tpu.runtime.zero import ZeroShardings
+from deepspeed_tpu.ops.optimizers import OptimizerDef, get_optimizer
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+BATCH_AXES = GROUP_ALIASES["dp"]  # ('data','expert')
+
+
+def _as_model_fns(model, loss_fn) -> Tuple[Callable, Callable]:
+    """Normalise a model into (init_fn, apply_fn).
+
+    Accepted forms: a flax.linen.Module, an object with .init/.apply, or an
+    (init_fn, apply_fn) tuple. ``apply_fn(params, *batch, rng=None,
+    train=True)`` must return loss, (loss, aux) or outputs (with ``loss_fn``).
+    """
+    try:
+        import flax.linen as nn
+
+        is_linen = isinstance(model, nn.Module)
+    except Exception:
+        is_linen = False
+
+    if isinstance(model, tuple) and len(model) == 2:
+        return model
+
+    if is_linen:
+        call_params = ()
+        try:
+            call_params = tuple(
+                inspect.signature(type(model).__call__).parameters)
+        except (TypeError, ValueError):
+            pass
+        takes_det = "deterministic" in call_params
+        takes_train = "train" in call_params
+
+        def init_fn(rng, *args):
+            kwargs = {}
+            if takes_det:
+                kwargs["deterministic"] = True
+            if takes_train:
+                kwargs["train"] = False
+            variables = model.init(rng, *args, **kwargs)
+            return variables["params"]
+
+        def apply_fn(params, *args, rng=None, train=True):
+            kwargs = {}
+            if takes_det:
+                kwargs["deterministic"] = not train
+            if takes_train:
+                kwargs["train"] = train
+            rngs = {"dropout": rng} if (rng is not None and train) else None
+            return model.apply({"params": params}, *args, rngs=rngs, **kwargs)
+
+        return init_fn, apply_fn
+
+    if hasattr(model, "init") and hasattr(model, "apply"):
+        return model.init, model.apply
+
+    raise TypeError(
+        f"model must be a flax Module, (init_fn, apply_fn) pair, or expose "
+        f".init/.apply — got {type(model)}")
+
+
+class DeepSpeedEngine:
+    """Training engine (reference runtime/engine.py:175)."""
+
+    def __init__(self,
+                 model: Any,
+                 config: Any = None,
+                 config_params: Any = None,
+                 model_parameters: Any = None,
+                 loss_fn: Optional[Callable] = None,
+                 topology: Optional[MeshTopology] = None,
+                 base_param_specs: Any = None,
+                 batch_spec: Any = None,
+                 lr_scheduler: Any = None,
+                 dont_change_device: bool = False):
+        self.accelerator = get_accelerator()
+        cfg = config if config is not None else config_params
+        self.config = (cfg if isinstance(cfg, DeepSpeedConfig)
+                       else DeepSpeedConfig(cfg or {}))
+        self.topology = topology if topology is not None else groups.get_topology()
+        groups.set_topology(self.topology)
+        self.mesh = self.topology.mesh
+
+        # Batch trio over the data-parallel axes (reference engine dp_world_size)
+        self.dp_world_size = self.topology.axis_size("dp")
+        self.config.resolve_batch_size(self.dp_world_size)
+
+        self.loss_fn = loss_fn
+        self.module = model
+        self._init_fn, self._apply_fn = _as_model_fns(model, loss_fn)
+
+        # precision ---------------------------------------------------------
+        self.compute_dtype = self.config.precision_dtype
+        self.fp16_enabled = self.config.fp16.enabled
+        self.bfloat16_enabled = self.config.bf16.enabled
+        self.dynamic_loss_scale = self.config.dynamic_loss_scale
+        if self.fp16_enabled and self.dynamic_loss_scale:
+            self._initial_scale = float(2.0 ** self.config.fp16.initial_scale_power)
+        elif self.fp16_enabled:
+            self._initial_scale = float(self.config.fp16.loss_scale)
+        else:
+            self._initial_scale = 1.0
+
+        # zero shardings ----------------------------------------------------
+        self.zero_stage = self.config.zero_optimization_stage
+        self.zero = ZeroShardings(
+            self.zero_stage, self.topology,
+            param_persistence_threshold=self.config.zero_config.param_persistence_threshold
+            if self.zero_stage >= 3 else 0)
+        self.base_param_specs = base_param_specs
+        if self.base_param_specs is None:
+            self.base_param_specs = getattr(model, "partition_rules", None)
+        self._batch_spec = batch_spec
+
+        # optimizer ---------------------------------------------------------
+        opt_cfg = self.config.optimizer
+        if opt_cfg is None:
+            opt_cfg_type, opt_params = "adamw", {}
+        else:
+            opt_cfg_type, opt_params = opt_cfg.type, dict(opt_cfg.params)
+        self._base_lr = float(opt_params.get("lr", 1e-3))
+        self.optimizer_def: OptimizerDef = get_optimizer(opt_cfg_type, opt_params)
+        self.optimizer = self  # reference returns engine.optimizer; state lives here
+
+        # lr scheduler ------------------------------------------------------
+        if lr_scheduler is not None:
+            self.lr_scheduler = lr_scheduler
+        elif self.config.scheduler is not None and self.config.scheduler.type:
+            fn = get_lr_schedule_fn(self.config.scheduler.type,
+                                    {**self.config.scheduler.params,
+                                     "lr": self._base_lr})
+            self.lr_scheduler = LRScheduler(fn)
+        else:
+            self.lr_scheduler = None
+
+        # bookkeeping -------------------------------------------------------
+        self.state: Optional[Dict[str, Any]] = None
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self._last_loss = None
+        self._seen_backward = False
+        self.training = True
+        self.gradient_accumulation_steps = lambda: \
+            self.config.gradient_accumulation_steps
+        self.train_micro_batch_size_per_gpu = lambda: \
+            self.config.train_micro_batch_size_per_gpu
+        self.train_batch_size = lambda: self.config.train_batch_size
+
+        # jit cache ---------------------------------------------------------
+        self._jit_micro: Optional[Callable] = None
+        self._jit_apply: Optional[Callable] = None
+        self._jit_eval: Optional[Callable] = None
+        self._shardings: Optional[Dict[str, Any]] = None
+        self._rng = jax.random.key(self.config.seed)
+
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+        self.monitor = MonitorMaster(self.config)
+
+        import deepspeed_tpu.comm as dist
+
+        dist.configure(self.config)
+
+        log_dist(
+            f"DeepSpeedEngine: zero_stage={self.zero_stage} "
+            f"dtype={self.compute_dtype.__name__ if hasattr(self.compute_dtype, '__name__') else self.compute_dtype} "
+            f"mesh={self.topology.dims.as_dict()} "
+            f"micro_batch={self.config.train_micro_batch_size_per_gpu} "
+            f"gas={self.config.gradient_accumulation_steps}", ranks=[0])
+
+        if model_parameters is not None:
+            self.init_state_from_params(model_parameters)
+
+    # ------------------------------------------------------------------ #
+    # Sharding / state construction
+    # ------------------------------------------------------------------ #
+    def _resolve_base_specs(self, params_shapes):
+        """TP base specs: None, a spec tree, or list of (regex, PartitionSpec)
+        rules matched against '/'-joined param paths."""
+        rules = self.base_param_specs
+        if rules is None:
+            return jax.tree.map(lambda _: None, params_shapes)
+        if isinstance(rules, (list, tuple)) and rules and isinstance(rules[0], tuple):
+            import re
+
+            flat = jax.tree_util.tree_flatten_with_path(params_shapes)[0]
+
+            def match(path):
+                name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                                for k in path)
+                for pat, spec in rules:
+                    if re.search(pat, name):
+                        return spec
+                return None
+
+            paths = {tuple(p): match(p) for p, _ in flat}
+            return jax.tree_util.tree_map_with_path(
+                lambda p, _: paths.get(tuple(p)), params_shapes)
+        return rules  # assume spec tree
+
+    def _build_shardings(self, params_shapes):
+        base = self._resolve_base_specs(params_shapes)
+        mesh = self.mesh
+        named = lambda tree: jax.tree.map(
+            lambda s: NamedSharding(mesh, s if s is not None else P()), tree,
+            is_leaf=lambda x: x is None or isinstance(x, P))
+        param_s = named(self.zero.param_specs(params_shapes, base))
+        master_s = named(self.zero.master_specs(params_shapes, base))
+        grad_s = named(self.zero.grad_specs(params_shapes, base))
+        scalar = NamedSharding(mesh, P())
+        opt_shapes = jax.eval_shape(self.optimizer_def.init, params_shapes)
+        # moments mirror the master sharding of their parameter
+        opt_s = jax.tree.map(
+            lambda leaf: None, opt_shapes)
+        opt_s = {k: jax.tree.map(lambda _m, s: s, opt_shapes[k], master_s)
+                 for k in opt_shapes}
+        self._shardings = {
+            "step": scalar, "opt_step": scalar,
+            "params": param_s, "master": master_s, "opt": opt_s,
+            "acc_grads": grad_s,
+            "loss_scale": scalar, "good_steps": scalar,
+        }
+        return self._shardings
+
+    def _state_shardings(self):
+        assert self._shardings is not None, "engine state not initialised"
+        return self._shardings
+
+    def init_state_from_params(self, host_params) -> None:
+        """Place an existing host/device param tree into sharded engine state."""
+        shapes = jax.eval_shape(lambda p: p, host_params)
+        sh = self._build_shardings(shapes)
+
+        @jax.jit
+        def build(params):
+            params32 = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+            return self._make_state(params32)
+
+        out_sh = dict(sh)
+        built = jax.jit(
+            lambda p: self._make_state(
+                jax.tree.map(lambda x: x.astype(jnp.float32), p)),
+            out_shardings=out_sh)(host_params)
+        self.state = built
+
+    def initialize_parameters(self, *sample_args, seed: Optional[int] = None):
+        """Construct params directly sharded (the reference's ``zero.Init``
+        construction-time partitioning, partition_parameters.py:734 — here a
+        jitted init with sharded out_shardings, so no rank ever materialises
+        the full model)."""
+        rng = jax.random.key(seed if seed is not None else self.config.seed)
+        shapes = jax.eval_shape(self._init_fn, rng, *sample_args)
+        sh = self._build_shardings(shapes)
+
+        def build(rng, *args):
+            params32 = self._init_fn(rng, *args)
+            params32 = jax.tree.map(lambda p: p.astype(jnp.float32), params32)
+            return self._make_state(params32)
+
+        self.state = jax.jit(build, out_shardings=dict(sh))(rng, *sample_args)
+        n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
+        log_dist(f"initialized {n_params/1e6:.2f}M parameters", ranks=[0])
+        return self.state
+
+    def _make_state(self, params32):
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params32)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "opt_step": jnp.zeros((), jnp.int32),
+            "params": jax.tree.map(lambda p: p.astype(self.compute_dtype), params32),
+            "master": params32,
+            "opt": self.optimizer_def.init(params32),
+            "acc_grads": zeros,
+            "loss_scale": jnp.asarray(self._initial_scale, jnp.float32),
+            "good_steps": jnp.zeros((), jnp.int32),
+        }
+
+    # ------------------------------------------------------------------ #
+    # Batch placement
+    # ------------------------------------------------------------------ #
+    def batch_sharding(self, leaf) -> NamedSharding:
+        if self._batch_spec is not None:
+            spec = self._batch_spec(leaf) if callable(self._batch_spec) \
+                else self._batch_spec
+        else:
+            spec = P(BATCH_AXES) if getattr(leaf, "ndim", 0) >= 1 else P()
+        return NamedSharding(self.mesh, spec)
+
+    def shard_batch(self, batch):
+        """Place a host (global) micro-batch onto the mesh, sharded over the
+        data-parallel axes."""
+        return jax.tree.map(
+            lambda leaf: jax.device_put(leaf, self.batch_sharding(leaf)), batch)
+
+    # ------------------------------------------------------------------ #
+    # Jitted programs
+    # ------------------------------------------------------------------ #
+    def _loss_from_outputs(self, out, args):
+        if self.loss_fn is not None:
+            return self.loss_fn(out, *args), None
+        if isinstance(out, tuple):
+            return out[0], out[1:]
+        return out, None
+
+    def _build_micro(self):
+        gas = float(self.config.gradient_accumulation_steps)
+        sh = self._state_shardings()
+
+        def micro(state, rng, *args):
+            params = state["params"]
+            scale = state["loss_scale"]
+
+            def scaled_loss_fn(p):
+                out = self._apply_fn(p, *args, rng=rng, train=True)
+                loss, _aux = self._loss_from_outputs(out, args)
+                return loss.astype(jnp.float32) * (scale / gas), loss
+
+            grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
+            (_, loss), grads = grad_fn(params)
+            acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                               state["acc_grads"], grads)
+            new_state = dict(state)
+            new_state["acc_grads"] = acc
+            return new_state, loss
+
+        self._jit_micro = jax.jit(
+            micro,
+            donate_argnums=(0,),
+            out_shardings=(dict(sh), NamedSharding(self.mesh, P())))
+
+    def _build_apply(self):
+        sh = self._state_shardings()
+        clip = float(self.config.gradient_clipping)
+        fp16 = self.fp16_enabled
+        dynamic = self.dynamic_loss_scale
+        cfg = self.config.fp16
+
+        def apply_step(state, lr):
+            inv_scale = 1.0 / state["loss_scale"]
+            grads = jax.tree.map(lambda g: g * inv_scale, state["acc_grads"])
+            # global grad norm (sharded leaves -> XLA inserts the reduction)
+            sumsq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
+            gnorm = jnp.sqrt(sumsq)
+            overflow = ~jnp.isfinite(gnorm) if fp16 else jnp.asarray(False)
+            if clip > 0.0:
+                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * coef, grads)
+
+            opt_step_next = state["opt_step"] + 1
+            new_master, new_opt = self.optimizer_def.update(
+                grads, state["opt"], state["master"], lr, opt_step_next)
+
+            keep = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(overflow, o, n), new, old)
+            new_master = keep(new_master, state["master"])
+            new_opt = keep(new_opt, state["opt"])
+
+            # dynamic loss scale update (reference fp16/loss_scaler.py)
+            scale = state["loss_scale"]
+            good = state["good_steps"]
+            if fp16 and dynamic:
+                window = cfg.loss_scale_window
+                new_scale = jnp.where(
+                    overflow,
+                    jnp.maximum(scale / 2.0, cfg.min_loss_scale),
+                    jnp.where(good + 1 >= window, scale * 2.0, scale))
+                new_good = jnp.where(overflow | (good + 1 >= window), 0, good + 1)
+            else:
+                new_scale, new_good = scale, good
+
+            new_state = {
+                "step": state["step"] + 1,
+                "opt_step": jnp.where(overflow, state["opt_step"], opt_step_next),
+                "params": jax.tree.map(
+                    lambda m: m.astype(self.compute_dtype), new_master),
+                "master": new_master,
+                "opt": new_opt,
+                "acc_grads": jax.tree.map(jnp.zeros_like, state["acc_grads"]),
+                "loss_scale": new_scale,
+                "good_steps": new_good,
+            }
+            return new_state, gnorm, overflow
+
+        scalar = NamedSharding(self.mesh, P())
+        self._jit_apply = jax.jit(
+            apply_step,
+            donate_argnums=(0,),
+            out_shardings=(dict(sh), scalar, scalar))
+
+    def _build_eval(self):
+        def ev(params, rng, *args):
+            return self._apply_fn(params, *args, rng=rng, train=False)
+
+        self._jit_eval = jax.jit(ev)
+
+    # ------------------------------------------------------------------ #
+    # Reference API: forward / backward / step
+    # ------------------------------------------------------------------ #
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args):
+        """Training: computes loss AND gradients in one device program; eval:
+        pure forward. (reference engine.forward:1772)"""
+        if self.state is None:
+            self.initialize_parameters(*args)
+        args = self.shard_batch(args)
+        self._rng, rng = jax.random.split(self._rng)
+        if not self.training:
+            if self._jit_eval is None:
+                self._build_eval()
+            return self._jit_eval(self.state["params"], rng, *args)
+        if self._jit_micro is None:
+            self._build_micro()
+        self.state, loss = self._jit_micro(self.state, rng, *args)
+        self._last_loss = loss
+        self._seen_backward = False
+        return loss
+
+    def backward(self, loss, retain_graph: bool = False):
+        """Gradients were produced by ``forward``; this keeps the reference's
+        call shape and advances the micro-step clock.
+        (reference engine.backward:1913)"""
+        del retain_graph
+        if self._seen_backward:
+            raise RuntimeError("backward() called twice for one forward()")
+        self._seen_backward = True
+        self.micro_steps += 1
+        self.global_samples += self.config.train_micro_batch_size_per_gpu * \
+            self.dp_world_size
+        return loss
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return self.micro_steps % self.config.gradient_accumulation_steps == 0
+
+    def get_lr(self):
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.last_batch_iteration = self.global_steps - 1
+            return [float(self.lr_scheduler.lr_fn(self.global_steps))]
+        return [self._base_lr]
+
+    def step(self):
+        """Optimizer step at gradient-accumulation boundaries.
+        (reference engine.step:2111 -> _take_model_step:2045)"""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        if self._jit_apply is None:
+            self._build_apply()
+        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        self.state, gnorm, overflow = self._jit_apply(self.state, lr)
+        self.global_steps += 1
+        if self.fp16_enabled:
+            # overflow is tiny; fetching it keeps skipped_steps accurate
+            if bool(jax.device_get(overflow)):
+                self.skipped_steps += 1
+                log_dist(
+                    f"step {self.global_steps}: fp16 overflow, skipping update "
+                    f"(loss scale -> {float(jax.device_get(self.state['loss_scale']))})",
+                    ranks=[0])
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step(self.global_steps)
+        if self.monitor.enabled and \
+                self.global_steps % self.config.steps_per_print == 0:
+            self.monitor.write_events([
+                ("Train/lr", self.get_lr()[0], self.global_steps)])
+        return gnorm
+
+    def train(self, mode: bool = True):
+        self.training = mode
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    # convenience: full fwd+bwd+step over one micro batch
+    def train_micro_batch(self, *args):
+        loss = self.forward(*args)
+        self.backward(loss)
+        self.step()
+        return loss
+
+    # ------------------------------------------------------------------ #
+    # Introspection (reference engine getters)
+    # ------------------------------------------------------------------ #
+    @property
+    def params(self):
+        return self.state["params"] if self.state else None
+
+    def get_global_grad_norm(self):
+        return None  # populated after step via return value
+
+    def zero_optimization(self) -> bool:
+        return self.zero_stage > 0
+
+    def zero_optimization_stage(self) -> int:
+        return self.zero_stage
+
+    def get_loss_scale(self) -> float:
+        if self.state is None:
+            return self._initial_scale
+        return float(jax.device_get(self.state["loss_scale"]))
+
+    def module_state_dict(self):
+        """Consolidated host copy of model weights (fp32 master)."""
+        from deepspeed_tpu.utils.tensors import tree_to_flat_dict
+
+        return tree_to_flat_dict(jax.device_get(self.state["master"]))
+
+    # ------------------------------------------------------------------ #
+    # Checkpointing (reference engine.save_checkpoint:3021 /
+    # load_checkpoint:2672)
+    # ------------------------------------------------------------------ #
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[dict] = None,
+                        save_latest: bool = True):
+        from deepspeed_tpu.checkpoint.engine import save_engine_state
+
+        tag = tag or f"global_step{self.global_steps}"
+        client_state = dict(client_state or {})
+        client_state.update({
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+        })
+        if self.lr_scheduler is not None:
+            client_state["lr_scheduler"] = self.lr_scheduler.state_dict()
+        save_engine_state(self, save_dir, tag, client_state,
+                          save_latest=save_latest)
+        return True
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_module_strict: bool = True,
+                        load_optimizer_states: bool = True,
+                        load_lr_scheduler_states: bool = True,
+                        load_module_only: bool = False):
+        from deepspeed_tpu.checkpoint.engine import load_engine_state
+
+        path, client_state = load_engine_state(
+            self, load_dir, tag,
+            load_optimizer_states=load_optimizer_states and not load_module_only)
+        if client_state:
+            self.global_steps = int(client_state.get("global_steps", 0))
+            self.global_samples = int(client_state.get("global_samples", 0))
+            self.micro_steps = int(client_state.get("micro_steps", 0))
+            self.skipped_steps = int(client_state.get("skipped_steps", 0))
+            if (load_lr_scheduler_states and self.lr_scheduler is not None
+                    and "lr_scheduler" in client_state):
+                self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        return path, client_state

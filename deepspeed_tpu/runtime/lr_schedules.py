@@ -1,0 +1,181 @@
+"""LR schedules (reference: runtime/lr_schedules.py — LRRangeTest, OneCycle,
+WarmupLR, WarmupDecayLR, WarmupCosineLR).
+
+Each schedule is a *pure function of the global step* so it can be evaluated
+inside the jitted optimizer step (branchless ``jnp.where`` forms — no Python
+control flow on traced values). The object wrappers keep the reference's
+``step()/get_last_lr()`` surface for host-side use.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+LRFn = Callable[[Any], Any]  # step (int array or python int) -> lr (f32)
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR,
+                      WARMUP_COSINE_LR]
+
+
+def _f(step):
+    return jnp.asarray(step).astype(jnp.float32)
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000, warmup_type: str = "log",
+              **_unused) -> LRFn:
+    """reference lr_schedules.py WarmupLR: min->max over warmup steps (log or
+    linear), then flat at max."""
+    wmin, wmax, wsteps = warmup_min_lr, warmup_max_lr, max(1, warmup_num_steps)
+
+    def fn(step):
+        s = _f(step)
+        frac = jnp.clip(s / wsteps, 0.0, 1.0)
+        if warmup_type == "log":
+            # log-space interpolation; reference uses log(1+step)/log(1+N)
+            frac = jnp.log1p(s) / math.log1p(wsteps)
+            frac = jnp.clip(frac, 0.0, 1.0)
+        return wmin + (wmax - wmin) * frac
+
+    return fn
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log", **_unused) -> LRFn:
+    """WarmupLR followed by linear decay to 0 at total_num_steps."""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+    wsteps = max(1, warmup_num_steps)
+    total = max(total_num_steps, wsteps + 1)
+
+    def fn(step):
+        s = _f(step)
+        decay = jnp.clip((total - s) / float(total - wsteps), 0.0, 1.0)
+        return jnp.where(s < wsteps, base(step), warmup_max_lr * decay)
+
+    return fn
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     warmup_type: str = "log", lr: float = 0.001,
+                     **_unused) -> LRFn:
+    """Warmup (as ratio of peak) then cosine decay to cos_min_ratio*peak."""
+    wsteps = max(1, warmup_num_steps)
+    total = max(total_num_steps, wsteps + 1)
+
+    def fn(step):
+        s = _f(step)
+        if warmup_type == "log":
+            wfrac = jnp.clip(jnp.log1p(s) / math.log1p(wsteps), 0.0, 1.0)
+        else:
+            wfrac = jnp.clip(s / wsteps, 0.0, 1.0)
+        warm_ratio = warmup_min_ratio + (1.0 - warmup_min_ratio) * wfrac
+        prog = jnp.clip((s - wsteps) / float(total - wsteps), 0.0, 1.0)
+        cos_ratio = cos_min_ratio + (1.0 - cos_min_ratio) * 0.5 * (
+            1.0 + jnp.cos(math.pi * prog))
+        return lr * jnp.where(s < wsteps, warm_ratio, cos_ratio)
+
+    return fn
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False, **_unused) -> LRFn:
+    """reference LRRangeTest: linearly (optionally staircase) increasing LR
+    for the Smith LR range test."""
+    min_lr, size, rate = lr_range_test_min_lr, max(1, lr_range_test_step_size), \
+        lr_range_test_step_rate
+
+    def fn(step):
+        s = _f(step)
+        interval = jnp.floor(s / size) if lr_range_test_staircase else s / size
+        return min_lr * (1.0 + interval * rate)
+
+    return fn
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: Optional[int] = None,
+              decay_step_size: int = 0, decay_lr_rate: float = 0.0,
+              cycle_first_stair_count: int = 0, cycle_second_stair_count=None,
+              cycle_momentum: bool = False, cycle_min_mom: float = 0.8,
+              cycle_max_mom: float = 0.9, decay_mom_rate: float = 0.0,
+              last_batch_iteration: int = -1, **_unused) -> LRFn:
+    """reference OneCycle: min->max over first phase, max->min over second,
+    then post-cycle decay."""
+    first = max(1, cycle_first_step_size)
+    second = cycle_second_step_size or first
+    cycle_end = first + second
+
+    def fn(step):
+        s = _f(step)
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * jnp.clip(
+            s / first, 0.0, 1.0)
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * jnp.clip(
+            (s - first) / second, 0.0, 1.0)
+        in_cycle = jnp.where(s < first, up, down)
+        if decay_step_size > 0 and decay_lr_rate > 0.0:
+            decay_steps = jnp.floor((s - cycle_end) / decay_step_size)
+            post = cycle_min_lr / (1.0 + decay_lr_rate * jnp.maximum(decay_steps, 0.0))
+        else:
+            post = jnp.asarray(cycle_min_lr, jnp.float32)
+        return jnp.where(s < cycle_end, in_cycle, post)
+
+    return fn
+
+
+_SCHEDULES: Dict[str, Callable[..., LRFn]] = {
+    WARMUP_LR.lower(): warmup_lr,
+    WARMUP_DECAY_LR.lower(): warmup_decay_lr,
+    WARMUP_COSINE_LR.lower(): warmup_cosine_lr,
+    LR_RANGE_TEST.lower(): lr_range_test,
+    ONE_CYCLE.lower(): one_cycle,
+}
+
+
+def get_lr_schedule_fn(name: str, params: Dict[str, Any]) -> LRFn:
+    key = name.lower()
+    if key not in _SCHEDULES:
+        raise ValueError(f"Unknown scheduler '{name}'. Valid: {VALID_LR_SCHEDULES}")
+    return _SCHEDULES[key](**dict(params))
+
+
+class LRScheduler:
+    """Host-side wrapper with the reference's object surface
+    (``step``/``get_last_lr``/``state_dict``)."""
+
+    def __init__(self, lr_fn: LRFn, last_batch_iteration: int = -1):
+        self.lr_fn = lr_fn
+        self.last_batch_iteration = last_batch_iteration
+
+    def step(self, last_batch_iteration: Optional[int] = None) -> None:
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+
+    def get_lr(self):
+        return [float(self.lr_fn(max(0, self.last_batch_iteration)))]
+
+    def get_last_lr(self):
+        return self.get_lr()
+
+    def state_dict(self):
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd):
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+    def __call__(self, step):
+        return self.lr_fn(step)

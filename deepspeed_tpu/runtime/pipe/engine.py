@@ -1,0 +1,16 @@
+"""Pipeline-parallel engine (reference: runtime/pipe/engine.py:321
+``PipelineEngine.train_batch``; schedules pipe/schedule.py:189).
+
+Round-1 placeholder: raises on construction. The full shard_map + ppermute
+1F1B implementation lands with the pipeline milestone.
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+
+class PipelineEngine(DeepSpeedEngine):
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "PipelineEngine is under construction in this build")

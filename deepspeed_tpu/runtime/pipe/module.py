@@ -1,0 +1,73 @@
+"""Pipeline module front-end (reference: runtime/pipe/module.py:86
+``PipelineModule``, :30 ``LayerSpec``).
+
+A pipeline model is a sequence of layer specs partitioned into stages over the
+'pipe' mesh axis. Stage execution is compiled into a single jitted program
+with ``shard_map`` over the pipe axis and ``ppermute`` stage transfer — see
+:mod:`deepspeed_tpu.runtime.pipe.engine`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+
+class LayerSpec:
+    """Deferred layer constructor (reference pipe/module.py:30)."""
+
+    def __init__(self, typename: Callable, *args, **kwargs):
+        self.typename = typename
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.args, **self.kwargs)
+
+    def __repr__(self) -> str:
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose params are tied across stages (reference pipe/module.py
+    TiedLayerSpec — e.g. embedding/unembedding weight tying)."""
+
+    def __init__(self, key: str, typename: Callable, *args,
+                 forward_fn: Optional[Callable] = None, **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+class PipelineModule:
+    """Partitions a layer list into pipeline stages
+    (reference pipe/module.py:370 ``_partition_layers``: uniform / parameters
+    / regex strategies)."""
+
+    def __init__(self, layers: Sequence[Any], num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 partition_method: str = "uniform",
+                 activation_checkpoint_interval: int = 0,
+                 seed_layers: bool = False, base_seed: int = 1234):
+        self.layer_specs: List[Any] = list(layers)
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.topology = topology
+
+    def partition_layers(self, num_stages: int) -> List[List[Any]]:
+        """Split layer specs into ``num_stages`` contiguous groups."""
+        n = len(self.layer_specs)
+        if self.partition_method not in ("uniform", "parameters"):
+            raise ValueError(
+                f"unknown partition_method {self.partition_method}")
+        # uniform: balanced contiguous split (parameters-weighted partitioning
+        # requires building layers; uniform is the default here)
+        bounds = [round(i * n / num_stages) for i in range(num_stages + 1)]
+        return [self.layer_specs[bounds[i]:bounds[i + 1]]
+                for i in range(num_stages)]
+
+    def __len__(self) -> int:
+        return len(self.layer_specs)

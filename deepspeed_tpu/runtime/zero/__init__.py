@@ -1,0 +1,3 @@
+from deepspeed_tpu.runtime.zero.partition import ZERO_AXES, ZeroShardings, shard_leaf_spec
+
+__all__ = ["ZeroShardings", "shard_leaf_spec", "ZERO_AXES"]

@@ -1,0 +1,13 @@
+from deepspeed_tpu.utils.logging import log_dist, logger, print_rank_0
+from deepspeed_tpu.utils.tensors import (
+    flat_dict_to_tree,
+    global_norm,
+    tree_num_params,
+    tree_size_bytes,
+    tree_to_flat_dict,
+)
+
+__all__ = [
+    "logger", "log_dist", "print_rank_0", "tree_to_flat_dict",
+    "flat_dict_to_tree", "tree_size_bytes", "tree_num_params", "global_norm",
+]

@@ -1,0 +1,53 @@
+"""Pytree <-> flat-dict utilities (reference: the flatten/unflatten utils
+csrc/utils/flatten_unflatten.cpp + runtime/utils.py tensor helpers)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    if hasattr(k, "name"):
+        return str(k.name)
+    return str(k)
+
+
+def tree_to_flat_dict(tree: Any, sep: str = "/") -> Dict[str, Any]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {sep.join(_key_str(k) for k in path): leaf for path, leaf in flat}
+
+
+def flat_dict_to_tree(flat: Dict[str, Any], template: Any, sep: str = "/") -> Any:
+    """Rebuild a pytree with ``template``'s structure from a flat dict."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, tmpl_leaf in paths:
+        key = sep.join(_key_str(k) for k in path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing key '{key}'")
+        leaves.append(flat[key])
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def tree_size_bytes(tree: Any) -> int:
+    return sum(int(np.prod(l.shape)) * l.dtype.itemsize
+               for l in jax.tree.leaves(tree) if hasattr(l, "shape"))
+
+
+def tree_num_params(tree: Any) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(tree)
+               if hasattr(l, "shape"))
+
+
+def global_norm(tree: Any):
+    import jax.numpy as jnp
+
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in jax.tree.leaves(tree)))

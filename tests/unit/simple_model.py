@@ -1,0 +1,72 @@
+"""Tiny deterministic model fixtures (reference: tests/unit/simple_model.py).
+
+``SimpleModel``: a stack of linear+gelu layers ending in an MSE/CE loss —
+enough structure to exercise sharding, precision, and optimizer paths without
+meaningful compile time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel:
+    """(init, apply) model: linear stack returning scalar MSE loss."""
+
+    def __init__(self, hidden_dim: int = 16, nlayers: int = 2,
+                 empty_grad: bool = False):
+        self.hidden_dim = hidden_dim
+        self.nlayers = nlayers
+
+    def init(self, rng, x, y):
+        keys = jax.random.split(rng, self.nlayers)
+        params = {}
+        for i, k in enumerate(keys):
+            params[f"layer_{i}"] = {
+                "kernel": jax.random.normal(
+                    k, (self.hidden_dim, self.hidden_dim), jnp.float32) * 0.05,
+                "bias": jnp.zeros((self.hidden_dim,), jnp.float32),
+            }
+        return params
+
+    def apply(self, params, x, y, rng=None, train=True):
+        h = x
+        for i in range(self.nlayers):
+            p = params[f"layer_{i}"]
+            h = h @ p["kernel"].astype(h.dtype) + p["bias"].astype(h.dtype)
+            if i < self.nlayers - 1:
+                h = jax.nn.gelu(h)
+        loss = jnp.mean(jnp.square(h - y))
+        return loss
+
+
+def random_dataset(n_samples: int, hidden_dim: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(n_samples, hidden_dim)).astype(np.float32)
+    ys = rng.normal(size=(n_samples, hidden_dim)).astype(np.float32)
+    return [(xs[i], ys[i]) for i in range(n_samples)]
+
+
+def random_batch(batch: int, hidden_dim: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(batch, hidden_dim)).astype(np.float32)
+    y = rng.normal(size=(batch, hidden_dim)).astype(np.float32)
+    return x, y
+
+
+def train_steps(engine, steps: int, batch: int, hidden_dim: int, seed: int = 0):
+    """Run N optimizer steps on a FIXED batch (overfit); returns losses."""
+    losses = []
+    gas = engine.config.gradient_accumulation_steps
+    x, y = random_batch(batch, hidden_dim, seed=seed)
+    for _ in range(steps):
+        for _ in range(gas):
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+        losses.append(float(jax.device_get(loss)))
+    return losses

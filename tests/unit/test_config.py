@@ -1,0 +1,122 @@
+"""Config system tests (reference: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def test_basic_parse():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "gradient_clipping": 1.0,
+    })
+    cfg.resolve_batch_size(dp_world_size=2)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.zero_optimization_stage == 2
+    assert cfg.bf16.enabled
+    assert cfg.gradient_clipping == 1.0
+    assert cfg.optimizer.params["lr"] == 1e-3
+
+
+def test_batch_trio_infer_gas():
+    cfg = DeepSpeedConfig({"train_batch_size": 32,
+                           "train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_size(dp_world_size=4)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_trio_infer_total():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2,
+                           "gradient_accumulation_steps": 3})
+    cfg.resolve_batch_size(dp_world_size=4)
+    assert cfg.train_batch_size == 24
+
+
+def test_batch_trio_conflict():
+    cfg = DeepSpeedConfig({"train_batch_size": 10,
+                           "train_micro_batch_size_per_gpu": 2,
+                           "gradient_accumulation_steps": 2})
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_size(dp_world_size=4)
+
+
+def test_missing_batch_raises():
+    cfg = DeepSpeedConfig({})
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_size(dp_world_size=1)
+
+
+def test_fp16_dynamic_scale():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "fp16": {"enabled": True}})
+    assert cfg.fp16.enabled
+    assert cfg.dynamic_loss_scale
+    import jax.numpy as jnp
+
+    assert cfg.precision_dtype == jnp.float16
+
+
+def test_fp16_static_scale():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "fp16": {"enabled": True, "loss_scale": 128}})
+    assert not cfg.dynamic_loss_scale
+
+
+def test_zero_stage_validation():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "zero_optimization": {"stage": 7}})
+
+
+def test_stage3_aliases():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_param_persistence_threshold": 1000,
+            "stage3_prefetch_bucket_size": 500,
+        }})
+    assert cfg.zero_config.param_persistence_threshold == 1000
+    assert cfg.zero_config.prefetch_bucket_size == 500
+
+
+def test_offload_configs():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu", "ratio": 0.5},
+            "offload_param": {"device": "nvme", "nvme_path": "/tmp/nvme"},
+        }})
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+    assert cfg.zero_config.offload_optimizer.ratio == 0.5
+    assert cfg.zero_config.offload_param.device == "nvme"
+
+
+def test_json_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 8, "bf16": {"enabled": True}}))
+    cfg = DeepSpeedConfig(str(p))
+    assert cfg.train_batch_size == 8
+    assert cfg.bf16.enabled
+
+
+def test_unknown_keys_warn_not_fail():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "zero_optimization": {"stage": 1,
+                                                 "totally_unknown_key": 1}})
+    assert cfg.zero_config.stage == 1
+
+
+def test_scheduler_block():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 10}}})
+    assert cfg.scheduler.type == "WarmupLR"

@@ -1,0 +1,71 @@
+"""Mesh topology tests (reference: tests/unit/test_topology.py for
+ProcessTopology coordinate algebra)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (
+    MeshTopology,
+    ParallelDims,
+    resolve_group,
+)
+from deepspeed_tpu.parallel import groups
+
+
+def test_resolve_data_axis():
+    topo = MeshTopology(ParallelDims())
+    assert topo.dims.data == 8
+    assert topo.world_size == 8
+    assert topo.data_parallel_size == 8
+
+
+def test_mixed_dims():
+    topo = MeshTopology(ParallelDims(data=2, model=2, pipe=2))
+    assert topo.dims.shape() == (2, 2, 1, 1, 2)
+    assert topo.model_parallel_size == 2
+    assert topo.pipe_parallel_size == 2
+    assert topo.zero_partition_size == 2
+
+
+def test_bad_dims_raise():
+    with pytest.raises(ValueError):
+        MeshTopology(ParallelDims(data=3))  # 8 % 3 != 0
+    with pytest.raises(ValueError):
+        MeshTopology(ParallelDims(data=2, model=2))  # covers only 4 of 8
+
+
+def test_coords_roundtrip():
+    topo = MeshTopology(ParallelDims(data=2, model=2, pipe=2))
+    for rank in range(topo.world_size):
+        coord = topo.get_coord(rank)
+        assert topo.get_rank(**coord) == rank
+
+
+def test_filter_match():
+    topo = MeshTopology(ParallelDims(data=4, model=2))
+    tp_group = topo.filter_match(pipe=0, data=0, seq=0, expert=0)
+    assert len(tp_group) == 2  # the two model-parallel ranks
+
+
+def test_axis_comm_lists():
+    topo = MeshTopology(ParallelDims(data=4, model=2))
+    data_lists = topo.get_axis_comm_lists("data")
+    assert len(data_lists) == 2  # one list per model rank
+    for lst in data_lists:
+        assert len(lst) == 4
+
+
+def test_group_aliases():
+    assert resolve_group("dp") == ("data", "expert")
+    assert resolve_group("sdp") == ("data", "seq", "expert")
+    assert resolve_group("tp") == ("model",)
+    assert resolve_group(None) == ("data", "seq", "expert")
+    assert resolve_group(("data",)) == ("data",)
+    with pytest.raises(ValueError):
+        resolve_group("nonsense")
+
+
+def test_global_groups_singleton():
+    topo = groups.initialize_mesh(model_parallel_size=2)
+    assert groups.get_topology() is topo
+    assert groups.get_model_parallel_world_size() == 2
+    assert groups.get_data_parallel_world_size() == 4

@@ -1,0 +1,76 @@
+"""ZeRO sharding-policy tests (reference: tests/unit/runtime/zero/)."""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import MeshTopology, ParallelDims
+from deepspeed_tpu.runtime.zero import ZeroShardings, shard_leaf_spec
+
+
+def _topo(**kw):
+    return MeshTopology(ParallelDims(**kw))
+
+
+def test_shard_leaf_picks_divisible_dim():
+    topo = _topo(data=8)
+    spec = shard_leaf_spec((16, 3), None, topo)
+    assert spec == P(("data", "seq", "expert"), None)
+
+
+def test_shard_leaf_respects_base_tp():
+    topo = _topo(data=4, model=2)
+    # dim0 sharded by TP already; ZeRO goes to dim1
+    spec = shard_leaf_spec((8, 8), P("model", None), topo)
+    assert spec == P("model", ("data", "seq", "expert"))
+
+
+def test_shard_leaf_combines_on_same_dim():
+    topo = _topo(data=4, model=2)
+    # dim1 too small; dim0 already sharded by model but 16/2=8 divisible by 4
+    spec = shard_leaf_spec((16, 3), P("model", None), topo)
+    assert spec == P(("model", "data", "seq", "expert"), None)
+
+
+def test_small_param_stays_replicated():
+    topo = _topo(data=8)
+    spec = shard_leaf_spec((16,), None, topo, min_size=100)
+    assert spec == P()
+
+
+def test_indivisible_stays_replicated():
+    topo = _topo(data=8)
+    spec = shard_leaf_spec((3, 5), None, topo)
+    assert spec == P(None, None)
+
+
+def test_stage_policies():
+    topo = _topo(data=8)
+    shapes = {"w": jax.ShapeDtypeStruct((16, 16), np.float32)}
+
+    for stage, (p_sharded, m_sharded, g_sharded) in {
+            0: (False, False, False),
+            1: (False, True, False),
+            2: (False, True, True),
+            3: (True, True, True)}.items():
+        zs = ZeroShardings(stage, topo)
+        p = zs.param_specs(shapes)["w"]
+        m = zs.master_specs(shapes)["w"]
+        g = zs.grad_specs(shapes)["w"]
+        assert (p != P()) == p_sharded, f"stage {stage} params"
+        assert (m != P()) == m_sharded, f"stage {stage} master"
+        assert (g != P()) == g_sharded, f"stage {stage} grads"
+
+
+def test_stage3_persistence_threshold():
+    topo = _topo(data=8)
+    shapes = {"big": jax.ShapeDtypeStruct((1024, 8), np.float32),
+              "small": jax.ShapeDtypeStruct((8, 8), np.float32)}
+    zs = ZeroShardings(3, topo, param_persistence_threshold=1000)
+    specs = zs.param_specs(shapes)
+    assert specs["big"] != P()
+    assert specs["small"] == P(None, None) or specs["small"] == P()
+    # master always shards regardless of persistence floor
+    m = zs.master_specs(shapes)
+    assert m["small"] != P()
