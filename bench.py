@@ -1,0 +1,112 @@
+"""Benchmark: training throughput of GPT-2-125M-class Llama on one chip.
+
+Prints ONE JSON line: tokens/sec/chip plus model FLOPs utilisation.
+``vs_baseline`` compares achieved MFU against the reference's published
+sustained utilisation (>54% of peak on A100, blogs/deepspeed-ulysses — see
+BASELINE.md): vs_baseline = our_mfu / 0.54.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    import jax
+
+    kind = ""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        pass
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 197e12  # conservative default (cpu-sim prints are meaningless anyway)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    # ~125M-parameter Llama
+    cfg_m = LlamaConfig(vocab_size=32000, hidden_size=768,
+                        intermediate_size=2048, num_hidden_layers=12,
+                        num_attention_heads=12, num_key_value_heads=12,
+                        max_position_embeddings=2048, dtype=jnp.bfloat16)
+    seq = 1024
+    micro_batch = 8
+
+    ds_config = {
+        "train_micro_batch_size_per_gpu": micro_batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=LlamaForCausalLM(cfg_m),
+                                               config=ds_config)
+    n_dev = engine.dp_world_size
+    batch = micro_batch * n_dev
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg_m.vocab_size, size=(batch, seq)).astype(np.int32)
+
+    def step():
+        loss = engine(ids, ids)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    # warmup + compile
+    for _ in range(3):
+        loss = step()
+    jax.block_until_ready(engine.state["params"])
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step()
+    jax.block_until_ready(engine.state["params"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    tokens_per_sec_per_chip = tokens_per_sec / n_dev
+
+    from deepspeed_tpu.utils.tensors import tree_num_params
+
+    n_params = tree_num_params(engine.state["master"])
+    # 6ND fwd+bwd model FLOPs (+ attention term)
+    att_flops = (12 * cfg_m.num_hidden_layers * cfg_m.hidden_size * seq) / \
+        (6 * n_params)
+    flops_per_token = 6 * n_params * (1 + att_flops)
+    mfu = tokens_per_sec_per_chip * flops_per_token / peak_flops_per_chip()
+
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip_gpt125m",
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.54, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "loss": float(jax.device_get(loss)),
+            "params_m": round(n_params / 1e6, 1),
+            "seq": seq, "batch": batch, "n_devices": n_dev,
+            "step_time_ms": round(1000 * dt / iters, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    cross_entropy_loss,
+)
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "GPT2Config",
+           "GPT2LMHeadModel", "cross_entropy_loss"]
+from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+__all__ += ["MixtralConfig", "MixtralForCausalLM"]

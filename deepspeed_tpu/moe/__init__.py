@@ -1,0 +1,9 @@
+from deepspeed_tpu.moe.layer import MoE
+from deepspeed_tpu.moe.sharded_moe import (
+    MOELayer,
+    TopKGate,
+    top1gating,
+    top2gating,
+)
+
+__all__ = ["MoE", "MOELayer", "TopKGate", "top1gating", "top2gating"]

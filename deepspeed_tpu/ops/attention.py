@@ -1,0 +1,54 @@
+"""Attention ops (reference: csrc/transformer/*.cu softmax/attention kernels;
+inference kernels csrc/transformer/inference/).
+
+``dot_product_attention`` is the single entry point; the ``implementation``
+switch selects between the XLA composition (fused well by the compiler) and
+the Pallas flash kernel (:mod:`deepspeed_tpu.ops.flash_attention`) once the
+shapes warrant it. Layout: [batch, seq, heads, head_dim] throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(q, k, v, *, causal: bool = True,
+                          mask: Optional[jax.Array] = None,
+                          scale: Optional[float] = None,
+                          implementation: str = "auto"):
+    """q: [B,Sq,H,D]; k/v: [B,Sk,Hkv,D] (GQA when Hkv < H)."""
+    if implementation in ("auto", "pallas"):
+        try:
+            from deepspeed_tpu.ops.flash_attention import (
+                flash_attention_usable, flash_attention)
+
+            if implementation == "pallas" or flash_attention_usable(q, k, v, causal,
+                                                                    mask):
+                return flash_attention(q, k, v, causal=causal, scale=scale)
+        except ImportError:
+            pass
+    return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale)
+
+
+def _xla_attention(q, k, v, *, causal, mask, scale):
+    b, sq, h, d = q.shape
+    _, sk, hkv, _ = k.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if hkv != h:
+        assert h % hkv == 0
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # [B,H,Sq,Sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(causal_mask[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

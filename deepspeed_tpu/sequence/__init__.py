@@ -1,0 +1,9 @@
+from deepspeed_tpu.sequence.layer import (
+    DistributedAttention,
+    SeqAllToAll,
+    seq_all_to_all,
+    ulysses_attention,
+)
+
+__all__ = ["DistributedAttention", "SeqAllToAll", "seq_all_to_all",
+           "ulysses_attention"]

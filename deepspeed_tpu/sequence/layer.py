@@ -1,0 +1,107 @@
+"""DeepSpeed-Ulysses sequence parallelism (reference: deepspeed/sequence/
+layer.py — ``single_all_to_all:15``, ``_SeqAllToAll:44``,
+``DistributedAttention:60``).
+
+Mechanism: inputs arrive sequence-sharded over the 'seq' mesh axis; before
+attention, an all-to-all re-partitions [B, S/p, H, D] -> [B, S, H/p, D]
+(heads scattered, sequence gathered) so any *local* attention runs on full
+sequences; the inverse all-to-all restores sequence sharding afterwards.
+
+Two equivalent implementations:
+
+* ``ulysses_attention`` — for code running under ``jit`` with auto sharding:
+  the re-partitions are ``with_sharding_constraint`` annotations and XLA
+  lowers them to ICI all-to-alls. This is the idiomatic TPU form — the
+  schedule and overlap come from the compiler.
+* ``SeqAllToAll`` / ``DistributedAttention`` — explicit ``lax.all_to_all``
+  form for ``shard_map`` regions (pipeline stages, custom kernels), matching
+  the reference's autograd.Function shape (the transposed all-to-all in the
+  backward pass falls out of JAX AD automatically).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import GROUP_ALIASES, resolve_group
+
+BATCH_AXES = GROUP_ALIASES["dp"]
+
+
+def seq_all_to_all(x, group="sp", scatter_idx: int = 2, gather_idx: int = 1):
+    """Explicit all-to-all for shard_map regions (reference
+    single_all_to_all, sequence/layer.py:15). scatter_idx/gather_idx follow
+    the reference convention on [B, S, H, D] tensors."""
+    axes = resolve_group(group)
+    if len(axes) != 1:
+        raise ValueError("sequence all-to-all needs exactly one mesh axis")
+    return lax.all_to_all(x, axes[0], split_axis=scatter_idx,
+                          concat_axis=gather_idx, tiled=True)
+
+
+class SeqAllToAll:
+    """reference _SeqAllToAll (sequence/layer.py:44). JAX AD supplies the
+    transposed collective in backward."""
+
+    @staticmethod
+    def apply(group, x, scatter_idx: int = 2, gather_idx: int = 1):
+        return seq_all_to_all(x, group=group, scatter_idx=scatter_idx,
+                              gather_idx=gather_idx)
+
+
+class DistributedAttention:
+    """reference DistributedAttention (sequence/layer.py:60): wraps any local
+    attention with head-scatter/seq-gather all-to-alls. For shard_map use."""
+
+    def __init__(self, local_attention: Callable, group="sp",
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        self.local_attn = local_attention
+        self.group = group
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        q = SeqAllToAll.apply(self.group, query, self.scatter_idx, self.gather_idx)
+        k = SeqAllToAll.apply(self.group, key, self.scatter_idx, self.gather_idx)
+        v = SeqAllToAll.apply(self.group, value, self.scatter_idx, self.gather_idx)
+        ctx = self.local_attn(q, k, v, *args, **kwargs)
+        # inverse: scatter sequence back, gather heads
+        return SeqAllToAll.apply(self.group, ctx, self.gather_idx,
+                                 self.scatter_idx)
+
+
+def ulysses_attention(attention_fn: Optional[Callable] = None,
+                      mesh=None, batch_axes: Tuple[str, ...] = BATCH_AXES,
+                      seq_axis: str = "seq"):
+    """Auto-sharding Ulysses: returns an attention_fn whose inputs/outputs are
+    sequence-sharded and whose interior is head-sharded; XLA inserts the
+    all-to-alls. Plug into model ``attention_fn=``."""
+    from deepspeed_tpu.ops.attention import dot_product_attention
+    from deepspeed_tpu.parallel import groups
+
+    inner = attention_fn or dot_product_attention
+
+    def fn(q, k, v, **kwargs):
+        m = mesh if mesh is not None else groups.get_mesh()
+        sp = m.shape[seq_axis]
+        seq_sharded = NamedSharding(m, P(batch_axes, seq_axis, None, None))
+
+        def scatter_heads(t):
+            # GQA: when kv-head count doesn't divide the seq degree, keep
+            # those heads replicated (gathered) — the Ulysses GQA fallback.
+            if t.shape[2] % sp == 0:
+                return lax.with_sharding_constraint(
+                    t, NamedSharding(m, P(batch_axes, None, seq_axis, None)))
+            return lax.with_sharding_constraint(
+                t, NamedSharding(m, P(batch_axes, None, None, None)))
+
+        q, k, v = (scatter_heads(t) for t in (q, k, v))
+        out = inner(q, k, v, **kwargs)
+        return lax.with_sharding_constraint(out, seq_sharded)
+
+    return fn
