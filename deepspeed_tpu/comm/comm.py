@@ -85,9 +85,16 @@ def init_distributed(dist_backend: str = "xla",
     _get_backend()
     _initialized = True
     if verbose:
-        logger.info(
-            f"Initialized comm backend=xla processes={get_world_size()} "
-            f"devices={len(jax.devices())}")
+        # Probe the backend defensively: a failed device-plugin init must not
+        # explode out of a log line (round-1 failure mode — the 'axon' TPU
+        # plugin raised from inside this f-string).
+        try:
+            n_procs_up, n_dev = get_world_size(), len(jax.devices())
+        except Exception as e:
+            logger.warning(f"comm initialized but device probe failed: {e}")
+        else:
+            logger.info(f"Initialized comm backend=xla processes={n_procs_up} "
+                        f"devices={n_dev}")
 
 
 def get_rank(group=None) -> int:
