@@ -9,10 +9,11 @@ Directory layout mirrors the reference so tooling expectations transfer::
     <save_dir>/<tag>/client_state.json
     <save_dir>/latest                                 # tag pointer
 
-Arrays are gathered to host as numpy (single-controller; multi-host uses
-process-0 consolidation via global device_get). The pluggable
-``CheckpointEngine`` interface matches the reference so an async/Nebula-style
-engine can swap in.
+Arrays are gathered to host as numpy: single-process via ``device_get``,
+multi-host via ``multihost_utils.process_allgather`` (collective — all
+processes participate) with process 0 as the sole file writer and a barrier
+before the ``latest`` tag is published. The pluggable ``CheckpointEngine``
+interface matches the reference so an async/Nebula-style engine can swap in.
 """
 
 from __future__ import annotations
@@ -49,8 +50,23 @@ class CheckpointEngine:
 
 
 def _to_numpy_flat(tree) -> Dict[str, np.ndarray]:
-    host = jax.device_get(tree)
+    """Full host copy of a (possibly sharded) tree.
+
+    Multi-host: ``jax.device_get`` raises on arrays spanning non-addressable
+    devices, so gather via ``multihost_utils.process_allgather`` — every
+    process gets the full value; only process 0 writes files.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        host = multihost_utils.process_allgather(tree, tiled=True)
+    else:
+        host = jax.device_get(tree)
     return {k: np.asarray(v) for k, v in tree_to_flat_dict(host).items()}
+
+
+def _is_writer() -> bool:
+    return jax.process_index() == 0
 
 
 def save_engine_state(engine, save_dir: str, tag: str,
@@ -59,31 +75,40 @@ def save_engine_state(engine, save_dir: str, tag: str,
                       checkpoint_engine: Optional[CheckpointEngine] = None) -> str:
     ce = checkpoint_engine or CheckpointEngine()
     path = os.path.join(save_dir, str(tag))
-    os.makedirs(path, exist_ok=True)
+    if _is_writer():
+        os.makedirs(path, exist_ok=True)
     ce.create(tag)
 
     state = engine.state
+    # Gathers are collective — every process participates; only process 0
+    # writes (shared-filesystem safe).
     model_flat = _to_numpy_flat(state["master"])
-    ce.save(model_flat, os.path.join(path, "mp_rank_00_model_states.npz"))
-
     optim = {
         "opt": state["opt"],
         "acc_grads": state["acc_grads"],
     }
     optim_flat = _to_numpy_flat(optim)
-    optim_flat["__step__"] = np.asarray(jax.device_get(state["step"]))
-    optim_flat["__opt_step__"] = np.asarray(jax.device_get(state["opt_step"]))
-    optim_flat["__loss_scale__"] = np.asarray(jax.device_get(state["loss_scale"]))
-    optim_flat["__good_steps__"] = np.asarray(jax.device_get(state["good_steps"]))
-    ce.save(optim_flat,
-            os.path.join(path, "zero_pp_rank_0_mp_rank_00_optim_states.npz"))
+    for name in ("step", "opt_step", "loss_scale", "good_steps", "hysteresis"):
+        if name in state:
+            optim_flat[f"__{name}__"] = np.asarray(jax.device_get(state[name]))
 
-    with open(os.path.join(path, "client_state.json"), "w") as f:
-        json.dump(client_state, f, indent=2, default=str)
+    if _is_writer():
+        ce.save(model_flat, os.path.join(path, "mp_rank_00_model_states.npz"))
+        ce.save(optim_flat,
+                os.path.join(path, "zero_pp_rank_0_mp_rank_00_optim_states.npz"))
+        with open(os.path.join(path, "client_state.json"), "w") as f:
+            json.dump(client_state, f, indent=2, default=str)
 
-    if save_latest:
+    # all processes reach this point before the tag is published
+    from deepspeed_tpu import comm as dist
+
+    dist.barrier()
+    if save_latest and _is_writer():
         with open(os.path.join(save_dir, "latest"), "w") as f:
             f.write(str(tag))
+    # second barrier: no process returns until the tag is published, so an
+    # immediate collective load(tag=None) sees the same checkpoint everywhere
+    dist.barrier()
     ce.commit(tag)
     return path
 
@@ -141,8 +166,9 @@ def load_engine_state(engine, load_dir: str, tag: Optional[str] = None,
                 optim["acc_grads"], sh["acc_grads"])
             for name, key in (("step", "__step__"), ("opt_step", "__opt_step__"),
                               ("loss_scale", "__loss_scale__"),
-                              ("good_steps", "__good_steps__")):
-                if key in scalars:
+                              ("good_steps", "__good_steps__"),
+                              ("hysteresis", "__hysteresis__")):
+                if key in scalars and name in sh:
                     new_state[name] = jax.device_put(
                         np.asarray(scalars[key]), sh[name])
 

@@ -13,6 +13,7 @@ compile-constant, which is what makes this formulation fast on TPU.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Callable, Optional, Tuple
 
 import flax.linen as nn
@@ -28,7 +29,9 @@ def _one_hot(idx, num: int, dtype=jnp.float32):
 
 def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
               min_capacity: int) -> int:
-    cap = int(num_tokens / num_experts * capacity_factor)
+    # ceil, matching the reference (sharded_moe.py _capacity): truncation
+    # would drop extra tokens whenever tokens/experts*factor is fractional.
+    cap = math.ceil(num_tokens / num_experts * capacity_factor)
     return max(cap, min_capacity)
 
 

@@ -24,12 +24,14 @@ def dot_product_attention(q, k, v, *, causal: bool = True,
         try:
             from deepspeed_tpu.ops.flash_attention import (
                 flash_attention_usable, flash_attention)
-
+        except ImportError:
+            if implementation == "pallas":
+                raise  # an explicit kernel request must not silently degrade
+        else:
             if implementation == "pallas" or flash_attention_usable(q, k, v, causal,
                                                                     mask):
-                return flash_attention(q, k, v, causal=causal, scale=scale)
-        except ImportError:
-            pass
+                return flash_attention(q, k, v, causal=causal, mask=mask,
+                                       scale=scale)
     return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale)
 
 

@@ -265,15 +265,13 @@ class DeepSpeedEngine:
         scalar = NamedSharding(mesh, P())
         opt_shapes = jax.eval_shape(self.optimizer_def.init, params_shapes)
         # moments mirror the master sharding of their parameter
-        opt_s = jax.tree.map(
-            lambda leaf: None, opt_shapes)
         opt_s = {k: jax.tree.map(lambda _m, s: s, opt_shapes[k], master_s)
                  for k in opt_shapes}
         self._shardings = {
             "step": scalar, "opt_step": scalar,
             "params": param_s, "master": master_s, "opt": opt_s,
             "acc_grads": grad_s,
-            "loss_scale": scalar, "good_steps": scalar,
+            "loss_scale": scalar, "good_steps": scalar, "hysteresis": scalar,
         }
         return self._shardings
 
@@ -285,18 +283,10 @@ class DeepSpeedEngine:
         """Place an existing host/device param tree into sharded engine state."""
         shapes = jax.eval_shape(lambda p: p, host_params)
         sh = self._build_shardings(shapes)
-
-        @jax.jit
-        def build(params):
-            params32 = jax.tree.map(lambda p: p.astype(jnp.float32), params)
-            return self._make_state(params32)
-
-        out_sh = dict(sh)
-        built = jax.jit(
+        self.state = jax.jit(
             lambda p: self._make_state(
                 jax.tree.map(lambda x: x.astype(jnp.float32), p)),
-            out_shardings=out_sh)(host_params)
-        self.state = built
+            out_shardings=dict(sh))(host_params)
 
     def initialize_parameters(self, *sample_args, seed: Optional[int] = None):
         """Construct params directly sharded (the reference's ``zero.Init``
@@ -328,6 +318,7 @@ class DeepSpeedEngine:
             "acc_grads": zeros,
             "loss_scale": jnp.asarray(self._initial_scale, jnp.float32),
             "good_steps": jnp.zeros((), jnp.int32),
+            "hysteresis": jnp.asarray(self.config.fp16.hysteresis, jnp.int32),
         }
 
     # ------------------------------------------------------------------ #
@@ -410,18 +401,30 @@ class DeepSpeedEngine:
             new_master = keep(new_master, state["master"])
             new_opt = keep(new_opt, state["opt"])
 
-            # dynamic loss scale update (reference fp16/loss_scaler.py)
+            # dynamic loss scale update (reference fp16/loss_scaler.py
+            # DynamicLossScaler: only lower the scale once `hysteresis`
+            # consecutive overflows have drained the counter)
             scale = state["loss_scale"]
             good = state["good_steps"]
+            hyst = state["hysteresis"]
             if fp16 and dynamic:
                 window = cfg.loss_scale_window
+                lower = overflow & (hyst <= 1)
+                grow = ~overflow & (good + 1 >= window)
                 new_scale = jnp.where(
-                    overflow,
-                    jnp.maximum(scale / 2.0, cfg.min_loss_scale),
-                    jnp.where(good + 1 >= window, scale * 2.0, scale))
-                new_good = jnp.where(overflow | (good + 1 >= window), 0, good + 1)
+                    lower, jnp.maximum(scale / 2.0, cfg.min_loss_scale),
+                    jnp.where(grow, scale * 2.0, scale))
+                new_good = jnp.where(overflow | grow, 0, good + 1)
+                full = jnp.asarray(cfg.hysteresis, jnp.int32)
+                if cfg.consecutive_hysteresis:
+                    # refill on every non-overflow step
+                    new_hyst = jnp.where(overflow, jnp.maximum(hyst - 1, 1), full)
+                else:
+                    # refill only when the scale window elapses cleanly
+                    new_hyst = jnp.where(overflow, jnp.maximum(hyst - 1, 1),
+                                         jnp.where(grow, full, hyst))
             else:
-                new_scale, new_good = scale, good
+                new_scale, new_good, new_hyst = scale, good, hyst
 
             new_state = {
                 "step": state["step"] + 1,
@@ -433,6 +436,7 @@ class DeepSpeedEngine:
                 "acc_grads": jax.tree.map(jnp.zeros_like, state["acc_grads"]),
                 "loss_scale": new_scale,
                 "good_steps": new_good,
+                "hysteresis": new_hyst,
             }
             return new_state, gnorm, overflow
 
@@ -489,8 +493,11 @@ class DeepSpeedEngine:
         return self.micro_steps % self.config.gradient_accumulation_steps == 0
 
     def get_lr(self):
+        """LR that the *next* optimizer step will apply. Derived from the
+        engine's step counter without mutating the scheduler, so
+        ``scheduler.get_last_lr()`` (updated by ``scheduler.step``) and this
+        stay consistent."""
         if self.lr_scheduler is not None:
-            self.lr_scheduler.last_batch_iteration = self.global_steps - 1
             return [float(self.lr_scheduler.lr_fn(self.global_steps))]
         return [self._base_lr]
 

@@ -119,20 +119,49 @@ def test_zero_stages_agree():
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
-def test_fp16_overflow_skips_step():
-    engine = _make_engine(_config(0, dtype="fp16"))
-    x, y = random_batch(16, HIDDEN)
-    loss = engine(x, y)
-    # poison grads with inf via giant input
-    engine.backward(loss)
-    engine.step()
-    s0 = engine.get_loss_scale()
+def _overflow_step(engine, x, y):
     xbad = np.full_like(x, 1e30)
     loss = engine(xbad, np.full_like(y, -1e30))
     engine.backward(loss)
     engine.step()
-    assert engine.skipped_steps >= 1
-    assert engine.get_loss_scale() < s0
+
+
+def test_fp16_overflow_skips_step():
+    engine = _make_engine(_config(0, dtype="fp16"))
+    x, y = random_batch(16, HIDDEN)
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+    s0 = engine.get_loss_scale()
+    # default hysteresis=2: the first overflow skips the update but keeps the
+    # scale; the second consecutive overflow halves it (reference
+    # runtime/fp16/loss_scaler.py DynamicLossScaler).
+    _overflow_step(engine, x, y)
+    assert engine.skipped_steps == 1
+    assert engine.get_loss_scale() == s0
+    _overflow_step(engine, x, y)
+    assert engine.skipped_steps == 2
+    assert engine.get_loss_scale() == s0 / 2
+
+
+def test_fp16_hysteresis_refill_on_growth():
+    cfg = _config(0, dtype="fp16")
+    cfg["fp16"]["hysteresis"] = 2
+    cfg["fp16"]["loss_scale_window"] = 2
+    engine = _make_engine(cfg)
+    x, y = random_batch(16, HIDDEN)
+    # drain hysteresis with one overflow
+    loss = engine(x, y); engine.backward(loss); engine.step()
+    _overflow_step(engine, x, y)
+    s_after_first = engine.get_loss_scale()
+    # two clean steps -> window elapses -> scale doubles AND hysteresis refills
+    for _ in range(2):
+        loss = engine(x, y); engine.backward(loss); engine.step()
+    assert engine.get_loss_scale() == s_after_first * 2
+    # a single overflow after refill must again not lower the scale
+    s0 = engine.get_loss_scale()
+    _overflow_step(engine, x, y)
+    assert engine.get_loss_scale() == s0
 
 
 def test_eval_mode():
