@@ -348,8 +348,13 @@ class DeepSpeedEngine:
             return out[0], out[1:]
         return out, None
 
+    def _grad_accum_divisor(self) -> float:
+        """Loss divisor per micro program (PipelineEngine overrides: its one
+        program already averages over all microbatches)."""
+        return float(self.config.gradient_accumulation_steps)
+
     def _build_micro(self):
-        gas = float(self.config.gradient_accumulation_steps)
+        gas = self._grad_accum_divisor()
         sh = self._state_shardings()
 
         def micro(state, rng, *args):

@@ -1,0 +1,9 @@
+"""Pipeline parallelism (reference: deepspeed/runtime/pipe/)."""
+
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec)
+from deepspeed_tpu.runtime.pipe.schedule import (InferenceSchedule,
+                                                 PipeSchedule, TrainSchedule)
+
+__all__ = ["LayerSpec", "TiedLayerSpec", "PipelineModule", "PipeSchedule",
+           "TrainSchedule", "InferenceSchedule"]

@@ -1,15 +1,37 @@
 """Pipeline module front-end (reference: runtime/pipe/module.py:86
-``PipelineModule``, :30 ``LayerSpec``).
+``PipelineModule``, :30 ``LayerSpec``, TiedLayerSpec).
 
-A pipeline model is a sequence of layer specs partitioned into stages over the
-'pipe' mesh axis. Stage execution is compiled into a single jitted program
-with ``shard_map`` over the pipe axis and ``ppermute`` stage transfer — see
-:mod:`deepspeed_tpu.runtime.pipe.engine`.
+A pipeline model is a list of layer specs. The reference partitions the
+*whole* list across stages and runs each stage's sub-list eagerly with p2p
+sends between ranks. The TPU-native design compiles the pipeline into one
+XLA program instead, which changes where layers live:
+
+* the **body** — the maximal homogeneous run of identical specs (the
+  transformer blocks, where all the FLOPs are) — is partitioned across the
+  ``'pipe'`` mesh axis. Its parameters are *stacked* with a leading
+  ``[num_stages, layers_per_stage]`` axis sharded over ``'pipe'``, and
+  executed inside a ``shard_map`` with ``ppermute`` stage transfers
+  (engine.py). This is the praxis/maxtext pipeline layout — idiomatic for
+  SPMD, and what lets ZeRO/TP sharding compose with PP on the other axes.
+* **pre** layers (embedding, positional) and **post** layers (final norm,
+  LM head) run as ordinary global sharded computation, replicated over the
+  pipe axis. For transformer LMs these are a tiny fraction of FLOPs, and it
+  makes tied embeddings (reference TiedLayerSpec / pipe/engine.py:257
+  ``_exec_reduce_tied_grads``) free: the tied weight is one global param, so
+  its gradient needs no special cross-stage reduction — XLA sums the
+  contributions.
+
+Layer callables: a spec's ``typename`` may be a flax ``nn.Module`` class, a
+class exposing ``init(rng, x)`` / ``apply(params, x)``, or a parameterless
+callable.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
 
 
 class LayerSpec:
@@ -23,13 +45,21 @@ class LayerSpec:
     def build(self):
         return self.typename(*self.args, **self.kwargs)
 
+    def _signature(self) -> Tuple:
+        return (self.typename, self.args, tuple(sorted(self.kwargs.items())))
+
     def __repr__(self) -> str:
         return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
 
 
 class TiedLayerSpec(LayerSpec):
-    """Layer whose params are tied across stages (reference pipe/module.py
-    TiedLayerSpec — e.g. embedding/unembedding weight tying)."""
+    """Layer whose params are shared across occurrences (reference
+    pipe/module.py TiedLayerSpec — e.g. embedding/unembedding tying).
+
+    The first occurrence owns the parameters; later occurrences apply
+    ``forward_fn(module, params, x)`` (default: the module's own apply) to
+    the *same* params.
+    """
 
     def __init__(self, key: str, typename: Callable, *args,
                  forward_fn: Optional[Callable] = None, **kwargs):
@@ -37,37 +67,251 @@ class TiedLayerSpec(LayerSpec):
         self.key = key
         self.forward_fn = forward_fn
 
+    def __repr__(self) -> str:
+        return f"TiedLayerSpec({self.key!r}, " \
+               f"{getattr(self.typename, '__name__', self.typename)})"
+
+
+def _as_layer(obj):
+    """Normalise a built layer into (init_fn(rng, x) -> params|None,
+    apply_fn(params, x) -> y)."""
+    try:
+        import flax.linen as nn
+
+        if isinstance(obj, nn.Module):
+            return (lambda rng, x: obj.init(rng, x)["params"],
+                    lambda p, x: obj.apply({"params": p}, x))
+    except Exception:
+        pass
+    if hasattr(obj, "init") and hasattr(obj, "apply"):
+        return obj.init, obj.apply
+    if callable(obj):
+        return (lambda rng, x: {}), (lambda p, x: obj(x))
+    raise TypeError(f"cannot use {type(obj)} as a pipeline layer")
+
 
 class PipelineModule:
-    """Partitions a layer list into pipeline stages
-    (reference pipe/module.py:370 ``_partition_layers``: uniform / parameters
-    / regex strategies)."""
+    """Partitions a layer-spec list for compiled pipeline execution
+    (reference pipe/module.py:370 ``_partition_layers``).
+
+    ``partition_method``:
+      * ``"uniform"`` / ``"parameters"`` — the body run is split into
+        ``num_stages`` equal groups (the body is homogeneous, so uniform ==
+        parameter-balanced; the reference distinguishes them only because its
+        stages may be heterogeneous).
+    ``activation_checkpoint_interval`` > 0 remats each body block.
+    """
 
     def __init__(self, layers: Sequence[Any], num_stages: Optional[int] = None,
                  topology=None, loss_fn: Optional[Callable] = None,
                  partition_method: str = "uniform",
                  activation_checkpoint_interval: int = 0,
-                 seed_layers: bool = False, base_seed: int = 1234):
-        self.layer_specs: List[Any] = list(layers)
+                 seed_layers: bool = False, base_seed: int = 1234,
+                 partition_rules: Optional[list] = None):
+        self.layer_specs: List[LayerSpec] = [
+            s if isinstance(s, LayerSpec) else LayerSpec(lambda f=s: f)
+            for s in layers]
         self.num_stages = num_stages
         self.loss_fn = loss_fn
+        if partition_method not in ("uniform", "parameters"):
+            raise ValueError(f"unknown partition_method {partition_method!r}")
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
         self.seed_layers = seed_layers
         self.base_seed = base_seed
         self.topology = topology
+        self._block_rules = partition_rules  # TP rules for one body block
+        self._finalized = False
+
+    # ------------------------------------------------------------------ #
+    # Partitioning
+    # ------------------------------------------------------------------ #
+    def _body_run(self) -> Tuple[int, int]:
+        """Locate the maximal run of identical specs — the pipelined body."""
+        specs = self.layer_specs
+        best = (0, 0)
+        i = 0
+        while i < len(specs):
+            if isinstance(specs[i], TiedLayerSpec):
+                i += 1
+                continue
+            j = i
+            sig = specs[i]._signature()
+            while j < len(specs) and not isinstance(specs[j], TiedLayerSpec) \
+                    and specs[j]._signature() == sig:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = max(j, i + 1)
+        if best[1] - best[0] < 1:
+            raise ValueError(
+                "PipelineModule needs a homogeneous run of layer specs to "
+                "pipeline (the repeated transformer blocks)")
+        return best
+
+    def finalize(self, num_stages: int) -> None:
+        """Bind the stage count and build layers. Called by the engine once
+        the mesh is known."""
+        if self._finalized and num_stages == self.num_stages:
+            return
+        self.num_stages = num_stages
+        b0, b1 = self._body_run()
+        n_body = b1 - b0
+        if n_body % num_stages != 0:
+            raise ValueError(
+                f"pipeline body has {n_body} layers, not divisible by "
+                f"{num_stages} stages")
+        self.layers_per_stage = n_body // num_stages
+        self._pre_specs = self.layer_specs[:b0]
+        self._body_spec = self.layer_specs[b0]
+        self._post_specs = self.layer_specs[b1:]
+        self.n_body = n_body
+
+        self._body_mod = self._body_spec.build()
+        self._body_init, self._body_apply = _as_layer(self._body_mod)
+        self._pre = [(s, *_as_layer(s.build())) for s in self._pre_specs]
+        self._post = [(s, *_as_layer(s.build())) for s in self._post_specs]
+        self._finalized = True
+
+    # ------------------------------------------------------------------ #
+    # Parameters
+    # ------------------------------------------------------------------ #
+    def init_fn(self, rng, *batch_args):
+        """Initialise the full pipeline param tree::
+
+            {"tied": {key: params}, "pre": [..], "body": stacked[S, L, ...],
+             "post": [..]}
+
+        Body leaves carry a leading ``[num_stages, layers_per_stage]``
+        stacked axis (sharded over 'pipe' by the engine's base specs).
+        """
+        assert self._finalized, "PipelineModule.finalize(num_stages) first"
+        x = batch_args[0]
+        params: Dict[str, Any] = {"tied": {}, "pre": [], "post": []}
+        tied_seen: Dict[str, Any] = {}
+        n_keys = len(self._pre) + len(self._post) + 1
+        if self.seed_layers:
+            # reference pipe/module.py seed_layers: deterministic per-layer
+            # seeding from base_seed, independent of the engine rng
+            base = jax.random.key(self.base_seed)
+            keys = [jax.random.fold_in(base, i)
+                    for i in range(n_keys + self.n_body)]
+        else:
+            keys = list(jax.random.split(rng, n_keys + self.n_body))
+
+        def run_edge(spec, init, apply, x, k):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in tied_seen:
+                    p = init(k, x)
+                    tied_seen[spec.key] = p
+                    params["tied"][spec.key] = p
+                else:
+                    p = tied_seen[spec.key]
+                # per-occurrence forward_fn, matching run_edge_layers
+                fwd = spec.forward_fn
+                y = fwd(spec.build(), p, x) if fwd is not None else apply(p, x)
+                return {}, y
+            p = init(k, x)
+            return p, apply(p, x)
+
+        ki = 0
+        for spec, init, apply in self._pre:
+            p, x = run_edge(spec, init, apply, x, keys[ki])
+            ki += 1
+            params["pre"].append(p)
+
+        # body: init each of the S*L blocks with its own rng, stack
+        S, L = self.num_stages, self.layers_per_stage
+        body_keys = jnp.stack(keys[n_keys:n_keys + self.n_body])
+        body_params = jax.vmap(lambda k: self._body_init(k, x))(body_keys)
+        params["body"] = jax.tree.map(
+            lambda leaf: leaf.reshape((S, L) + leaf.shape[1:]), body_params)
+        x = self._body_apply(jax.tree.map(lambda l: l[0, 0], params["body"]), x)
+
+        for spec, init, apply in self._post:
+            p, x = run_edge(spec, init, apply, x, keys[ki])
+            ki += 1
+            params["post"].append(p)
+        return params
+
+    # ------------------------------------------------------------------ #
+    # Execution pieces (used by PipelineEngine)
+    # ------------------------------------------------------------------ #
+    def _edges(self, which: str):
+        return self._pre if which == "pre" else self._post
+
+    def run_edge_layers(self, params, x, which: str):
+        """Apply pre or post layers to a (stacked-microbatch) activation."""
+        tied = params["tied"]
+        for (spec, _init, apply), p in zip(self._edges(which), params[which]):
+            if isinstance(spec, TiedLayerSpec):
+                tp = tied[spec.key]
+                if spec.forward_fn is not None:
+                    x = spec.forward_fn(spec.build(), tp, x)
+                else:
+                    x = apply(tp, x)
+            else:
+                x = apply(p, x)
+        return x
+
+    def stage_apply(self, stage_params, x):
+        """Run this stage's blocks; ``stage_params`` leaves are ``[L, ...]``."""
+        apply = self._body_apply
+        if self.activation_checkpoint_interval > 0:
+            apply = jax.checkpoint(apply)
+
+        def body(carry, layer_p):
+            return apply(layer_p, carry), None
+
+        y, _ = jax.lax.scan(body, x, stage_params)
+        return y
+
+    def sequential_apply(self, params, x):
+        """Reference (non-pipelined) execution of the same params — used by
+        tests for parity and by the single-stage fallback."""
+        x = self.run_edge_layers(params, x, "pre")
+        S, L = self.num_stages, self.layers_per_stage
+        flat = jax.tree.map(
+            lambda l: l.reshape((S * L,) + l.shape[2:]), params["body"])
+        x = self.stage_apply(flat, x)
+        return self.run_edge_layers(params, x, "post")
+
+    # ------------------------------------------------------------------ #
+    # Engine integration
+    # ------------------------------------------------------------------ #
+    @property
+    def partition_rules(self):
+        """Base PartitionSpecs: body leaves get P('pipe') on the stage axis,
+        composed with per-block TP rules shifted past the [S, L] axes."""
+        from jax.sharding import PartitionSpec as P
+
+        rules = []
+        if self._block_rules:
+            # Preserve re.search semantics of the user's block-level rule:
+            # anchored rules re-anchor after 'body/'; unanchored ones may
+            # match anywhere inside the block's sub-path.
+            for pat, spec in self._block_rules:
+                full = ("^body/" + pat[1:]) if pat.startswith("^") \
+                    else ("^body/.*" + pat)
+                rules.append((full, P(*(("pipe", None) + tuple(spec)))))
+        rules.append(("^body/.*", P("pipe")))
+        return rules
 
     def partition_layers(self, num_stages: int) -> List[List[Any]]:
-        """Split layer specs into ``num_stages`` contiguous groups."""
-        n = len(self.layer_specs)
-        if self.partition_method not in ("uniform", "parameters"):
-            raise ValueError(
-                f"unknown partition_method {self.partition_method}")
-        # uniform: balanced contiguous split (parameters-weighted partitioning
-        # requires building layers; uniform is the default here)
-        bounds = [round(i * n / num_stages) for i in range(num_stages + 1)]
-        return [self.layer_specs[bounds[i]:bounds[i + 1]]
-                for i in range(num_stages)]
+        """Reference-shaped view: the layer list split into stage groups."""
+        self.finalize(num_stages)
+        out: List[List[Any]] = []
+        b0 = len(self._pre_specs)
+        for s in range(num_stages):
+            grp: List[Any] = []
+            if s == 0:
+                grp += list(self._pre_specs)
+            grp += self.layer_specs[b0 + s * self.layers_per_stage:
+                                    b0 + (s + 1) * self.layers_per_stage]
+            if s == num_stages - 1:
+                grp += list(self._post_specs)
+            out.append(grp)
+        return out
 
     def __len__(self) -> int:
         return len(self.layer_specs)

@@ -1,0 +1,254 @@
+"""Pipeline-parallel tests (reference: tests/unit/runtime/pipe/test_pipe.py
+and pipe/test_pipe_schedule.py).
+
+PP=2 / PP=4 training on the 8-device CPU mesh must match non-pipelined
+execution of the *same parameters* (the compiled schedule is semantically a
+sequential sweep), plus tied-embedding and 1F1B-schedule-spec checks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.runtime.pipe import (InferenceSchedule, LayerSpec,
+                                        PipelineModule, TiedLayerSpec,
+                                        TrainSchedule)
+from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass,
+                                                 OptimizerStep)
+
+HID = 16
+
+
+class Block:
+    """Shape-preserving toy transformer block: linear + tanh."""
+
+    def __init__(self, hidden=HID):
+        self.hidden = hidden
+
+    def init(self, rng, x):
+        k1, k2 = jax.random.split(rng)
+        return {"kernel": jax.random.normal(k1, (self.hidden, self.hidden),
+                                            jnp.float32) * 0.3,
+                "bias": jax.random.normal(k2, (self.hidden,), jnp.float32) * 0.1}
+
+    def apply(self, p, x):
+        return jnp.tanh(x @ p["kernel"] + p["bias"])
+
+
+class InProj:
+    def __init__(self, d_in, d_out):
+        self.d_in, self.d_out = d_in, d_out
+
+    def init(self, rng, x):
+        return {"kernel": jax.random.normal(rng, (self.d_in, self.d_out),
+                                            jnp.float32) * 0.3}
+
+    def apply(self, p, x):
+        return x @ p["kernel"]
+
+
+def tied_out(module, params, x):
+    """Untied-direction reuse of the InProj weight (embedding tying)."""
+    return x @ params["kernel"].T
+
+
+def mse(out, y):
+    return jnp.mean(jnp.square(out - y))
+
+
+def make_module(n_blocks=4, tied=False, d_in=8, remat=0):
+    layers = []
+    if tied:
+        layers.append(TiedLayerSpec("embed", InProj, d_in, HID))
+    else:
+        layers.append(LayerSpec(InProj, d_in, HID))
+    layers += [LayerSpec(Block, HID) for _ in range(n_blocks)]
+    if tied:
+        layers.append(TiedLayerSpec("embed", InProj, d_in, HID,
+                                    forward_fn=tied_out))
+    else:
+        layers.append(LayerSpec(InProj, HID, d_in))
+    return PipelineModule(layers, loss_fn=mse,
+                          activation_checkpoint_interval=remat)
+
+
+def make_batches(m, mb, d_in, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.normal(size=(mb, d_in)).astype(np.float32),
+             rng.normal(size=(mb, d_in)).astype(np.float32))
+            for _ in range(m)]
+
+
+CFG = {
+    "train_micro_batch_size_per_gpu": 4,
+    "gradient_accumulation_steps": 4,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    "zero_optimization": {"stage": 0},
+}
+
+
+def _train(engine, steps, batches):
+    losses = []
+    for _ in range(steps):
+        losses.append(float(jax.device_get(
+            engine.train_batch(data=batches))))
+    return losses
+
+
+@pytest.mark.parametrize("pp,dp", [(2, 4), (4, 2)])
+def test_pipeline_matches_dense(pp, dp):
+    """PP training == non-pipelined training of identical params."""
+    topo = groups.initialize_mesh(pipe_parallel_size=pp,
+                                  data_parallel_size=dp)
+    module = make_module(n_blocks=4)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=module, config=dict(CFG),
+                                               topology=topo)
+    batches = make_batches(4, 4 * dp, 8)
+    stacked0 = tuple(np.stack([np.asarray(mb[i]) for mb in batches])
+                     for i in range(2))
+    engine.initialize_parameters(*stacked0)
+    pipe_params = jax.device_get(engine.state["master"])
+    pipe_losses = _train(engine, 3, batches)
+
+    # dense twin: same initial params, sequential execution, its own mesh
+    groups.reset()
+    topo2 = groups.initialize_mesh(data_parallel_size=8)
+
+    def dense_apply(params, xs, ys, rng=None, train=True):
+        outs = jax.vmap(lambda x: module.sequential_apply(params, x))(xs)
+        return jnp.mean(jax.vmap(mse)(outs, ys))
+
+    from jax.sharding import PartitionSpec as P
+
+    dense, _, _, _ = deepspeed_tpu.initialize(
+        model=(lambda rng, *a: pipe_params, dense_apply),
+        model_parameters=pipe_params, config=dict(CFG), topology=topo2,
+        batch_spec=lambda leaf: P(None, ("data", "expert"))
+        if getattr(leaf, "ndim", 0) >= 2 else P())
+    stacked = tuple(np.stack([np.asarray(mb[i]) for mb in batches])
+                    for i in range(2))
+    dense_losses = []
+    for _ in range(3):
+        loss = dense.forward(*stacked)
+        dense.backward(loss)
+        dense.micro_steps += CFG["gradient_accumulation_steps"] - 1
+        dense.step()
+        dense_losses.append(float(jax.device_get(loss)))
+
+    np.testing.assert_allclose(pipe_losses, dense_losses, rtol=2e-5)
+
+
+def test_pipeline_tied_embedding():
+    """Tied in/out projection: params stay identical (one tensor), training
+    decreases loss (reference tied-weight reduction semantics)."""
+    topo = groups.initialize_mesh(pipe_parallel_size=2, data_parallel_size=4)
+    module = make_module(n_blocks=4, tied=True)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=module, config=dict(CFG),
+                                               topology=topo)
+    batches = make_batches(4, 16, 8)
+    losses = _train(engine, 5, batches)
+    assert losses[-1] < losses[0], losses
+    # exactly one 'embed' tied tensor exists in the tree
+    master = engine.state["master"]
+    assert "embed" in master["tied"]
+    assert master["pre"] == [{}] and master["post"] == [{}]
+
+
+def test_pipeline_with_zero_and_remat():
+    """PP=2 × ZeRO-2 × remat trains and matches PP=2 ZeRO-0 losses."""
+    results = {}
+    for stage, remat in [(0, 0), (2, 1)]:
+        groups.reset()
+        topo = groups.initialize_mesh(pipe_parallel_size=2,
+                                      data_parallel_size=4)
+        cfg = dict(CFG)
+        cfg["zero_optimization"] = {"stage": stage}
+        module = make_module(n_blocks=4, remat=remat)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=module, config=cfg,
+                                                   topology=topo)
+        results[stage] = _train(engine, 3, make_batches(4, 16, 8))
+    np.testing.assert_allclose(results[0], results[2], rtol=2e-5)
+
+
+def test_pipeline_forward_raises():
+    topo = groups.initialize_mesh(pipe_parallel_size=2, data_parallel_size=4)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=make_module(), config=dict(CFG), topology=topo)
+    with pytest.raises(RuntimeError, match="train_batch"):
+        engine.forward(np.zeros((4, 4, 8), np.float32))
+    with pytest.raises(RuntimeError, match="train_batch"):
+        engine.backward(None)
+
+
+def test_pipeline_model_parameters_sharded():
+    """Passing model_parameters= through initialize() must still produce
+    pipe-sharded body state (regression: specs were set after state init)."""
+    topo = groups.initialize_mesh(pipe_parallel_size=2, data_parallel_size=4)
+    module = make_module(n_blocks=4)
+    module.finalize(2)
+    params = module.init_fn(jax.random.key(0),
+                            np.zeros((4, 8), np.float32),
+                            np.zeros((4, 8), np.float32))
+    params = jax.device_get(params)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, config=dict(CFG), topology=topo,
+        model_parameters=params)
+    leaf = jax.tree.leaves(engine.state["params"]["body"])[0]
+    assert "pipe" in jax.tree_util.tree_leaves(
+        [leaf.sharding.spec])[0] or leaf.sharding.spec[0] == "pipe"
+    loss = engine.train_batch(data=make_batches(4, 16, 8))
+    assert np.isfinite(float(jax.device_get(loss)))
+
+
+def test_partition_layers_view():
+    module = make_module(n_blocks=8)
+    parts = module.partition_layers(4)
+    assert len(parts) == 4
+    assert len(parts[0]) == 3    # in-proj + 2 blocks
+    assert len(parts[3]) == 3    # 2 blocks + out-proj
+    assert all(len(p) == 2 for p in parts[1:3])
+
+
+# ---------------------------------------------------------------------- #
+# Schedule specification (reference tests/unit/runtime/pipe/test_pipe_schedule)
+# ---------------------------------------------------------------------- #
+def test_train_schedule_1f1b_order():
+    """Every stage sees M forwards and M backwards; forward f of microbatch m
+    precedes its backward; at most (stages - stage_id) forwards outstanding."""
+    M, S = 8, 4
+    for sid in range(S):
+        sched = TrainSchedule(micro_batches=M, stages=S, stage_id=sid)
+        fwd, bwd = [], []
+        outstanding = 0
+        max_outstanding = 0
+        for cmds in sched.steps():
+            for c in cmds:
+                if isinstance(c, ForwardPass):
+                    fwd.append(c.buffer_id)
+                    outstanding += 1
+                    max_outstanding = max(max_outstanding, outstanding)
+                elif isinstance(c, BackwardPass):
+                    bwd.append(c.buffer_id)
+                    outstanding -= 1
+        assert fwd == list(range(M))
+        assert bwd == list(range(M))
+        assert max_outstanding <= S - sid, (sid, max_outstanding)
+
+
+def test_train_schedule_ends_with_optimizer():
+    sched = TrainSchedule(micro_batches=4, stages=2, stage_id=0)
+    steps = list(sched.steps())
+    assert any(isinstance(c, OptimizerStep) for c in steps[-1])
+    assert not any(isinstance(c, OptimizerStep)
+                   for cmds in steps[:-1] for c in cmds)
+
+
+def test_inference_schedule_ticks():
+    sched = InferenceSchedule(micro_batches=6, stages=3, stage_id=1)
+    assert sched.num_ticks == 8
+    fwd = [c.buffer_id for cmds in sched.steps() for c in cmds
+           if isinstance(c, ForwardPass)]
+    assert fwd == list(range(6))
