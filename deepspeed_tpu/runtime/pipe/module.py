@@ -72,6 +72,18 @@ class TiedLayerSpec(LayerSpec):
                f"{getattr(self.typename, '__name__', self.typename)})"
 
 
+class _ObjectSpec(LayerSpec):
+    """Wraps an already-built layer object; identical objects form a
+    homogeneous (pipelinable) run."""
+
+    def __init__(self, obj):
+        super().__init__(lambda o=obj: o)
+        self._obj = obj
+
+    def _signature(self) -> Tuple:
+        return ("object", id(self._obj))
+
+
 def _as_layer(obj):
     """Normalise a built layer into (init_fn(rng, x) -> params|None,
     apply_fn(params, x) -> y)."""
@@ -109,7 +121,7 @@ class PipelineModule:
                  seed_layers: bool = False, base_seed: int = 1234,
                  partition_rules: Optional[list] = None):
         self.layer_specs: List[LayerSpec] = [
-            s if isinstance(s, LayerSpec) else LayerSpec(lambda f=s: f)
+            s if isinstance(s, LayerSpec) else _ObjectSpec(s)
             for s in layers]
         self.num_stages = num_stages
         self.loss_fn = loss_fn

@@ -1,0 +1,133 @@
+"""Flash-attention kernel numerics vs the XLA composition (the reference's
+kernel-vs-eager-torch test pattern, tests/unit/ops/ — SURVEY §4).
+
+Runs the real Pallas kernels through the interpreter on CPU, so the exact
+TPU kernel code is exercised by the suite.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import _xla_attention, dot_product_attention
+from deepspeed_tpu.ops.flash_attention import (flash_attention,
+                                               flash_attention_usable)
+
+
+def _make(b=2, sq=256, sk=256, h=4, hkv=4, d=64, dtype=jnp.float32, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(kq, (b, sq, h, d), dtype)
+    k = jax.random.normal(kk, (b, sk, hkv, d), dtype)
+    v = jax.random.normal(kv, (b, sk, hkv, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("hkv", [4, 2, 1])
+def test_flash_forward_matches_xla(causal, hkv):
+    q, k, v = _make(hkv=hkv)
+    ref = _xla_attention(q, k, v, causal=causal, mask=None, scale=None)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_grads_match_xla(causal):
+    q, k, v = _make(h=4, hkv=2)
+
+    def loss_f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       interpret=True) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, causal=causal, mask=None,
+                                      scale=None) ** 2)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        scale = float(jnp.abs(b).max()) + 1e-9
+        np.testing.assert_allclose(np.asarray(a) / scale,
+                                   np.asarray(b) / scale,
+                                   atol=1e-4, err_msg=f"d{name}")
+
+
+def test_flash_rectangular_and_blocks():
+    """Sq != Sk (cross/extended attention) and non-default block sizes."""
+    q, k, v = _make(sq=128, sk=512)
+    ref = _xla_attention(q, k, v, causal=False, mask=None, scale=None)
+    out = flash_attention(q, k, v, causal=False, block_q=64, block_k=128,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_rectangular_causal_end_aligned():
+    """Causal with sq != sk is end-aligned (query i sees keys <= i + sk-sq),
+    matching the XLA path's tril(k=sk-sq) — the chunked-decode case."""
+    q, k, v = _make(sq=128, sk=512)
+    ref = _xla_attention(q, k, v, causal=True, mask=None, scale=None)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=128,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def loss_f(q):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=64,
+                                       block_k=128, interpret=True) ** 2)
+
+    def loss_r(q):
+        return jnp.sum(_xla_attention(q, k, v, causal=True, mask=None,
+                                      scale=None) ** 2)
+
+    gf, gr = jax.grad(loss_f)(q), jax.grad(loss_r)(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), atol=1e-3)
+
+
+def test_flash_bf16():
+    q, k, v = _make(dtype=jnp.bfloat16)
+    ref = _xla_attention(q, k, v, causal=True, mask=None, scale=None)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_flash_custom_scale():
+    q, k, v = _make()
+    ref = _xla_attention(q, k, v, causal=True, mask=None, scale=0.5)
+    out = flash_attention(q, k, v, causal=True, scale=0.5, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_flash_rejects_mask():
+    q, k, v = _make(sq=128, sk=128)
+    with pytest.raises(NotImplementedError):
+        flash_attention(q, k, v, mask=jnp.ones((1, 1, 128, 128), bool),
+                        interpret=True)
+
+
+def test_flash_usable_gate():
+    q, k, v = _make(sq=256, sk=256)
+    # CPU platform: not usable (auto path keeps XLA)
+    assert not flash_attention_usable(q, k, v, True, None)
+    # mask always falls back
+    assert not flash_attention_usable(q, k, v, True, jnp.ones((1,), bool))
+    # indivisible sequence falls back
+    q2, k2, v2 = _make(sq=250, sk=250)
+    assert not flash_attention_usable(q2, k2, v2, True, None)
+
+
+def test_dot_product_attention_pallas_switch():
+    """implementation='pallas' must run the kernel (interpret off-TPU is the
+    kernel path, not a silent XLA fallback)."""
+    q, k, v = _make(sq=128, sk=128)
+    ref = _xla_attention(q, k, v, causal=True, mask=None, scale=None)
+    out = dot_product_attention(q, k, v, causal=True, implementation="pallas")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_op_builder_flash_entry():
+    from deepspeed_tpu.ops.op_builder import get_op_builder
+
+    fn = get_op_builder("flash_attn").load()
+    assert fn is flash_attention
