@@ -1,0 +1,91 @@
+"""Inference config (reference: deepspeed/inference/config.py
+``DeepSpeedInferenceConfig``).
+
+Keeps the reference's field surface (tensor_parallel / dtype /
+max_out_tokens / replace_with_kernel_inject / checkpoint knobs) so configs
+carry over; GPU-only fields (enable_cuda_graph, use_triton) are accepted and
+reported as no-ops on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.utils.logging import logger
+
+_DTYPE_MAP = {
+    "fp32": jnp.float32, "float32": jnp.float32, "float": jnp.float32,
+    "fp16": jnp.float16, "float16": jnp.float16, "half": jnp.float16,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
+
+
+@dataclasses.dataclass
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """reference inference/config.py DeepSpeedTPConfig"""
+
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Any = None
+    tp_group: Any = None
+
+
+@dataclasses.dataclass
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """reference inference/config.py:82 DeepSpeedInferenceConfig."""
+
+    kernel_inject: bool = dataclasses.field(
+        default=False, metadata={"aliases": ("replace_with_kernel_inject",)})
+    dtype: Any = "bf16"
+    tensor_parallel: Any = dataclasses.field(
+        default=None, metadata={"aliases": ("tp",)})
+    max_out_tokens: int = dataclasses.field(
+        default=1024, metadata={"aliases": ("max_tokens",)})
+    min_out_tokens: int = 1
+    max_batch_size: Optional[int] = None
+    checkpoint: Optional[Any] = None
+    base_dir: str = ""
+    seed: int = 0
+    replace_method: str = dataclasses.field(
+        default="auto", metadata={"deprecated": True})
+    injection_policy: Optional[Dict] = dataclasses.field(
+        default=None, metadata={"aliases": ("injection_dict",)})
+    return_tuple: bool = True
+    triangular_masking: bool = True
+    moe: Any = None
+    quant: Any = None
+    # GPU-only knobs, accepted for config compatibility:
+    enable_cuda_graph: bool = False
+    use_triton: bool = False
+    triton_autotune: bool = False
+    zero: Any = None
+    ds_config: Any = None
+    save_mp_checkpoint_path: Optional[str] = None
+    mp_size: int = dataclasses.field(
+        default=1, metadata={"deprecated": True})  # honoured in __post_init__
+
+    def __post_init__(self):
+        if isinstance(self.dtype, str):
+            key = self.dtype.lower().replace("torch.", "")
+            if key not in _DTYPE_MAP:
+                raise ValueError(f"unknown inference dtype {self.dtype!r}")
+            self.dtype = _DTYPE_MAP[key]
+        if self.tensor_parallel is None:
+            self.tensor_parallel = DeepSpeedTPConfig(
+                tp_size=max(1, int(self.mp_size)))
+        elif isinstance(self.tensor_parallel, dict):
+            self.tensor_parallel = DeepSpeedTPConfig.from_dict(
+                self.tensor_parallel)
+        for knob in ("enable_cuda_graph", "use_triton", "triton_autotune"):
+            if getattr(self, knob):
+                logger.warning(f"inference config: '{knob}' is GPU-only and "
+                               "ignored on TPU (XLA compiles whole graphs)")
+
+    @property
+    def tp_size(self) -> int:
+        return self.tensor_parallel.tp_size

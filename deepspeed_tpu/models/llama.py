@@ -110,11 +110,23 @@ def apply_rotary(x, cos, sin):
     return out.astype(x.dtype)
 
 
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: int):
+    """Per-layer KV cache pytree for incremental decoding (the role of the
+    reference's inference KV buffers, ops/transformer/inference)."""
+    shape = (batch, max_len, config.num_key_value_heads, config.head_dim)
+    return {
+        f"layers_{i}": {"k": jnp.zeros(shape, config.dtype),
+                        "v": jnp.zeros(shape, config.dtype)}
+        for i in range(config.num_hidden_layers)
+    }
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, attention_fn=None):
+    def __call__(self, x, positions, attention_fn=None, cache=None,
+                 cache_index=None):
         cfg = self.config
         h, hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
                      cfg.head_dim)
@@ -128,9 +140,31 @@ class LlamaAttention(nn.Module):
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
         attn = attention_fn or dot_product_attention
-        out = attn(q, k, v, causal=True)
+        if cache is None:
+            out = attn(q, k, v, causal=True)
+            new_cache = None
+        else:
+            # write the new keys/values at cache_index
+            ck = jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, cache_index, 0, 0))
+            new_cache = {"k": ck, "v": cv}
+            if x.shape[1] > 1 and isinstance(cache_index, int) \
+                    and cache_index == 0:
+                # prefill from an empty cache: plain causal attention over
+                # the fresh k/v — flash-kernel eligible (no mask needed)
+                out = attn(q, k, v, causal=True)
+            else:
+                # incremental decode: attend over the cache with a validity
+                # mask (key_pos <= query_pos)
+                max_len = ck.shape[1]
+                key_pos = jnp.arange(max_len, dtype=jnp.int32)
+                mask = key_pos[None, None, None, :] <= \
+                    positions[:, None, :, None]
+                out = attn(q, ck, cv, causal=False, mask=mask)
         out = out.reshape(*x.shape[:2], h * d)
-        return dense(cfg.hidden_size, "o_proj")(out)
+        return dense(cfg.hidden_size, "o_proj")(out), new_cache
 
 
 class LlamaMLP(nn.Module):
@@ -151,15 +185,16 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, attention_fn=None):
+    def __call__(self, x, positions, attention_fn=None, cache=None,
+                 cache_index=None):
         cfg = self.config
-        a = LlamaAttention(cfg, name="self_attn")(
+        a, new_cache = LlamaAttention(cfg, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, name="input_layernorm")(x),
-            positions, attention_fn)
+            positions, attention_fn, cache, cache_index)
         x = x + a
         m = LlamaMLP(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, name="post_attention_layernorm")(x))
-        return x + m
+        return x + m, new_cache
 
 
 class LlamaModel(nn.Module):
@@ -167,29 +202,40 @@ class LlamaModel(nn.Module):
     attention_fn: Any = None
 
     @nn.compact
-    def __call__(self, input_ids, tie_logits: bool = False):
+    def __call__(self, input_ids, tie_logits: bool = False, positions=None,
+                 cache=None, cache_index=None):
         cfg = self.config
         b, s = input_ids.shape
-        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(s, dtype=jnp.int32)[None], (b, s))
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                          dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="embed_tokens")
         x = embed(input_ids)
         block = LlamaBlock
-        if cfg.remat:
+        if cfg.remat and cache is None:
             block = nn.remat(
                 LlamaBlock,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        new_cache = {} if cache is not None else None
         for i in range(cfg.num_hidden_layers):
-            x = block(cfg, name=f"layers_{i}")(x, positions, self.attention_fn)
+            name = f"layers_{i}"
+            layer_cache = cache[name] if cache is not None else None
+            x, c = block(cfg, name=name)(x, positions, self.attention_fn,
+                                         layer_cache, cache_index)
+            if cache is not None:
+                new_cache[name] = c
         x = RMSNorm(cfg.rms_norm_eps, name="norm")(x)
         if tie_logits:
-            return embed.attend(x.astype(cfg.dtype))
-        return x
+            x = embed.attend(x.astype(cfg.dtype))
+        return (x, new_cache) if cache is not None else x
 
 
 class LlamaForCausalLM(nn.Module):
-    """Returns loss when labels given (train contract), else logits."""
+    """Returns loss when labels given (train contract), else logits.
+    With ``cache`` (see :func:`init_kv_cache`) runs incremental decoding and
+    returns ``(logits, new_cache)``."""
 
     config: LlamaConfig
     attention_fn: Any = None
@@ -200,18 +246,21 @@ class LlamaForCausalLM(nn.Module):
         return LLAMA_PARTITION_RULES
 
     @nn.compact
-    def __call__(self, input_ids, labels=None):
+    def __call__(self, input_ids, labels=None, positions=None, cache=None,
+                 cache_index=None):
         cfg = self.config
+        out = LlamaModel(cfg, self.attention_fn, name="model")(
+            input_ids, tie_logits=cfg.tie_word_embeddings,
+            positions=positions, cache=cache, cache_index=cache_index)
+        x, new_cache = out if cache is not None else (out, None)
         if cfg.tie_word_embeddings:
-            logits = LlamaModel(cfg, self.attention_fn, name="model")(
-                input_ids, tie_logits=True)
+            logits = x
         else:
-            x = LlamaModel(cfg, self.attention_fn, name="model")(input_ids)
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="lm_head")(x)
-        if labels is None:
-            return logits
-        return cross_entropy_loss(logits, labels)
+        if labels is not None:
+            return cross_entropy_loss(logits, labels)
+        return (logits, new_cache) if cache is not None else logits
 
 
 def cross_entropy_loss(logits, labels, ignore_index: int = -100):

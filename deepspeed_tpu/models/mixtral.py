@@ -71,7 +71,7 @@ class MixtralBlock(nn.Module):
     def __call__(self, x, positions, attention_fn=None, train: bool = True,
                  rng=None):
         cfg = self.config
-        a = LlamaAttention(cfg, name="self_attn")(
+        a, _ = LlamaAttention(cfg, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, name="input_layernorm")(x),
             positions, attention_fn)
         x = x + a

@@ -1,0 +1,7 @@
+"""Model surgery for inference TP (reference: deepspeed/module_inject/)."""
+
+from deepspeed_tpu.module_inject.auto_tp import (AutoTP,
+                                                 ReplaceWithTensorSlicing,
+                                                 tp_parser)
+
+__all__ = ["AutoTP", "tp_parser", "ReplaceWithTensorSlicing"]

@@ -1,0 +1,115 @@
+"""AutoTP — policy-free tensor-parallel sharding (reference:
+module_inject/auto_tp.py:187 ``AutoTP``, ``tp_parser:271``,
+``ReplaceWithTensorSlicing:30`` in replace_module.py).
+
+The reference walks a torch module graph, classifies each Linear as
+row/column parallel, and physically slices weights per rank. The TPU-native
+equivalent classifies parameters of a *pytree* by name/shape and emits
+``(regex, PartitionSpec)`` rules over the 'model' mesh axis — GSPMD does the
+actual slicing and inserts the all-reduces the reference adds by hand
+(auto_tp.py:317 ``_replace``).
+
+Classification mirrors the reference's parser:
+
+* **column-parallel** (output-dim sharded, no collective after):
+  q/k/v/query/key/value projections, MLP up/gate/fc1/w1/w3, fused qkv;
+* **row-parallel** (input-dim sharded, all-reduce after — GSPMD infers it):
+  attention output o_proj/dense/out_proj/wo, MLP down/fc2/w2;
+* **vocab-parallel**: token embeddings and lm_head;
+* everything else (norms, biases of row-parallel layers): replicated.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# name fragments → policy, matched against the '/'-joined param path
+_COLUMN = (r"q_proj|k_proj|v_proj|query|(?<!o_proj/)(?<!\w)key(?!\w)|value|"
+           r"qkv|query_key_value|gate_proj|up_proj|fc1|c_fc|w1(?!\d)|w3|"
+           r"wi(?!\w)|dense_h_to_4h|in_proj")
+_ROW = (r"o_proj|out_proj|dense_4h_to_h|down_proj|fc2|c_proj|w2(?!\d)|"
+        r"wo(?!\w)|attn?[._/]dense|attention[._/]dense")
+_VOCAB = r"embed_tokens|wte|word_embeddings|embedding|lm_head|embed_out"
+
+
+def tp_parser(params_or_shapes: Any,
+              model_axis: str = "model") -> List[Tuple[str, P]]:
+    """Derive TP partition rules for a param tree (reference
+    ``AutoTP.tp_parser`` auto_tp.py:271). Returns ``(regex, PartitionSpec)``
+    rules consumable by the engines' ``base_param_specs``."""
+    flat = jax.tree_util.tree_flatten_with_path(params_or_shapes)[0]
+    rules: List[Tuple[str, P]] = []
+    seen = set()
+
+    def add(pattern: str, spec: P):
+        if pattern not in seen:
+            seen.add(pattern)
+            rules.append((pattern, spec))
+
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        ndim = len(getattr(leaf, "shape", ()))
+        if ndim < 2:
+            continue  # biases/norms replicate
+        low = name.lower()
+        if re.search(_VOCAB, low):
+            # vocab dim is the bigger of the two for embeddings
+            shape = leaf.shape
+            vocab_dim = int(np.argmax(shape[-2:]))
+            spec = [None] * ndim
+            spec[ndim - 2 + vocab_dim] = model_axis
+            add(re.escape(name) + "$", P(*spec))
+        elif re.search(_COLUMN, low):
+            add(re.escape(name) + "$", P(*([None] * (ndim - 1) + [model_axis])))
+        elif re.search(_ROW, low):
+            add(re.escape(name) + "$",
+                P(*([None] * (ndim - 2) + [model_axis, None])))
+    return rules
+
+
+class AutoTP:
+    """Reference-shaped wrapper (auto_tp.py:187)."""
+
+    def __init__(self, module=None, all_reduce_linears=None, prefix="",
+                 state_dict=None, linear_layer_setting=None,
+                 orig_layer_impl=None):
+        self.module = module
+
+    @staticmethod
+    def tp_parser(params_or_shapes, model_axis: str = "model"):
+        return tp_parser(params_or_shapes, model_axis)
+
+
+class ReplaceWithTensorSlicing:
+    """Places host params onto the mesh under TP rules (the reference class
+    physically slices torch tensors per rank — replace_module.py:30; here
+    ``jax.device_put`` with NamedShardings does the slicing)."""
+
+    def __init__(self, mesh, rules=None, model_axis: str = "model"):
+        self.mesh = mesh
+        self.model_axis = model_axis
+        self.rules = rules
+
+    def sharding_for_path(self, path) -> NamedSharding:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        spec = P()
+        for pat, s in self.rules or ():
+            if re.search(pat, name):
+                spec = s
+                break
+        return NamedSharding(self.mesh, spec)
+
+    def shard_tree(self, params):
+        if self.rules is None:
+            self.rules = tp_parser(params, self.model_axis)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        leaves = [jax.device_put(leaf, self.sharding_for_path(path))
+                  for path, leaf in flat]
+        return jax.tree_util.tree_unflatten(treedef, leaves)

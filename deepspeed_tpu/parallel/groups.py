@@ -47,9 +47,11 @@ def is_initialized() -> bool:
     return _topology is not None
 
 
-def get_topology() -> MeshTopology:
+def get_topology(optional: bool = False):
     global _topology
     if _topology is None:
+        if optional:
+            return None
         # Default: pure data parallel over every visible device.
         _topology = initialize_mesh()
     return _topology
