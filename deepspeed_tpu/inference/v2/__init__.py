@@ -1,0 +1,13 @@
+"""Inference v2 — FastGen-style continuous batching (reference:
+deepspeed/inference/v2/).
+
+``InferenceEngineV2`` exposes the reference's ``put/query/flush`` API over a
+paged (blocked) KV cache and a fixed-token-budget ragged batch — Dynamic
+SplitFuse prompt chunking keeps every forward the same static shape, which
+is exactly what XLA wants.
+"""
+
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+__all__ = ["InferenceEngineV2", "RaggedInferenceEngineConfig"]

@@ -1,0 +1,55 @@
+"""Inference v2 config (reference: inference/v2/config_v2.py
+``RaggedInferenceEngineConfig``, ``DSStateManagerConfig``
+ragged/manager_configs.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+@dataclasses.dataclass
+class DSStateManagerConfig(DeepSpeedConfigModel):
+    """reference ragged/manager_configs.py:DSStateManagerConfig."""
+
+    max_tracked_sequences: int = 2048
+    max_ragged_batch_size: int = 256      # token budget per forward
+    max_ragged_sequence_count: int = 32   # sequences per forward
+    max_context: int = 8192               # per-sequence context bound
+    memory_config: Any = None
+    offload: bool = False
+
+    def _validate(self):
+        if self.max_ragged_sequence_count > self.max_ragged_batch_size:
+            raise ValueError("max_ragged_sequence_count cannot exceed the "
+                             "token budget (max_ragged_batch_size)")
+
+
+@dataclasses.dataclass
+class KVCacheConfig(DeepSpeedConfigModel):
+    """reference ragged/manager_configs.py:KVCacheConfig (blocked KV)."""
+
+    block_size: int = 64
+    num_blocks: Optional[int] = None     # None -> derived from max_context
+    cache_dtype: Any = None
+
+
+@dataclasses.dataclass
+class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
+    """reference inference/v2/config_v2.py:30."""
+
+    tensor_parallel: Any = None
+    state_manager: Any = None
+    kv_cache: Any = None
+    quantization: Any = None
+
+    def __post_init__(self):
+        if not isinstance(self.state_manager, DSStateManagerConfig):
+            self.state_manager = DSStateManagerConfig.from_dict(
+                self.state_manager or {})
+        if not isinstance(self.kv_cache, KVCacheConfig):
+            self.kv_cache = KVCacheConfig.from_dict(self.kv_cache or {})
+        if self.tensor_parallel is None:
+            self.tensor_parallel = {"tp_size": 1}

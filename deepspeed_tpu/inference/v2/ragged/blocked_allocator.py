@@ -1,0 +1,48 @@
+"""Block allocator for the paged KV cache (reference:
+inference/v2/ragged/blocked_allocator.py ``BlockedAllocator`` — a linked-list
+free list over int32 blocks; this is the same structure in plain python).
+
+Block 0 is reserved as the *trash block*: padding tokens in a ragged batch
+scatter their (garbage) KV writes there, so the device program needs no
+branches for pad lanes.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+
+class BlockedAllocator:
+    TRASH_BLOCK = 0
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (one is the trash block)")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(1, num_blocks))  # 0 reserved
+
+    @property
+    def free_blocks(self) -> int:
+        """reference blocked_allocator.py free_blocks property."""
+        return len(self._free)
+
+    def allocate(self, num_blocks: int) -> List[int]:
+        """reference ``allocate``: returns block ids or raises when
+        exhausted."""
+        if num_blocks > len(self._free):
+            raise RuntimeError(
+                f"KV cache exhausted: requested {num_blocks} blocks, "
+                f"{len(self._free)} free")
+        out, self._free = self._free[:num_blocks], self._free[num_blocks:]
+        return out
+
+    def free(self, blocks: Iterable[int]) -> None:
+        """reference ``free``: returns blocks to the free list."""
+        for b in blocks:
+            if b == self.TRASH_BLOCK:
+                raise ValueError("cannot free the trash block")
+            if not 0 < b < self.num_blocks:
+                raise ValueError(f"invalid block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(blocks)

@@ -1,0 +1,45 @@
+"""Blocked (paged) KV cache (reference: inference/v2/ragged/kv_cache.py
+``BlockedKVCache`` over CUDA block pools).
+
+Device layout per layer: ``k/v: [num_blocks * block_size, Hkv, D]`` — a flat
+pool indexed by ``block_id * block_size + offset``. Ragged token writes are
+one scatter; per-sequence reads are one gather through the block table.
+XLA turns both into dynamic-slice/scatter fusions; a Pallas
+paged-attention kernel can later consume the same layout unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class BlockedKVCache:
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 num_kv_heads: int, head_dim: int, dtype: Any = jnp.bfloat16):
+        self.num_layers = num_layers
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        flat = num_blocks * block_size
+        self.cache: Dict[str, Dict[str, jax.Array]] = {
+            f"layer_{i}": {
+                "k": jnp.zeros((flat, num_kv_heads, head_dim), dtype),
+                "v": jnp.zeros((flat, num_kv_heads, head_dim), dtype),
+            }
+            for i in range(num_layers)
+        }
+
+    # The engine threads self.cache through the jitted forward and stores the
+    # updated pytree back here (functional update — no aliasing surprises).
+    def update(self, new_cache) -> None:
+        self.cache = new_cache
+
+    @property
+    def per_token_bytes(self) -> int:
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return 2 * self.num_layers * self.num_kv_heads * self.head_dim * itemsize

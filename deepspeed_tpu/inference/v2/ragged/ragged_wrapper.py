@@ -1,0 +1,112 @@
+"""Ragged batch metadata (reference: inference/v2/ragged/ragged_wrapper.py
+``RaggedBatchWrapper`` — token/sequence metadata staged through a pinned
+host buffer ★fast_host_buffer.cu; here plain numpy arrays handed to one
+jitted forward).
+
+A ragged batch is a fixed-size token buffer (the Dynamic SplitFuse token
+budget) packing tokens from up to ``max_seqs`` sequences::
+
+    token_ids  [T] int32   padded with 0
+    token_slot [T] int32   which batch slot each token belongs to (pad -> 0,
+                           but pads scatter KV to the trash block)
+    token_pos  [T] int32   absolute position in its sequence
+    block_tables [max_seqs, max_blocks] int32  KV block ids (trash-padded)
+    context_lens [max_seqs] int32  tokens valid after this forward
+    logits_idx   [max_seqs] int32  index in [T] of each slot's last token
+    kv_dest      [T] int32  flat pool index for each token's KV write
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import (
+    DSSequenceDescriptor,
+)
+
+TRASH = BlockedAllocator.TRASH_BLOCK
+
+
+class RaggedBatchWrapper:
+    def __init__(self, token_budget: int, max_seqs: int, max_blocks: int,
+                 block_size: int):
+        self.token_budget = token_budget
+        self.max_seqs = max_seqs
+        self.max_blocks = max_blocks
+        self.block_size = block_size
+        self.clear()
+
+    def clear(self):
+        self._seqs: List[DSSequenceDescriptor] = []
+        self._chunks: List[np.ndarray] = []
+        self._tokens_used = 0
+
+    @property
+    def current_tokens(self) -> int:
+        return self._tokens_used
+
+    @property
+    def current_sequences(self) -> int:
+        return len(self._seqs)
+
+    def can_fit(self, n_tokens: int) -> bool:
+        return (self._tokens_used + n_tokens <= self.token_budget
+                and len(self._seqs) < self.max_seqs)
+
+    def insert_sequence(self, seq: DSSequenceDescriptor,
+                        tokens: np.ndarray) -> None:
+        """reference ``insert_sequence``: add one sequence's chunk."""
+        if not self.can_fit(len(tokens)):
+            raise RuntimeError("ragged batch full")
+        self._seqs.append(seq)
+        self._chunks.append(np.asarray(tokens, np.int32))
+        self._tokens_used += len(tokens)
+
+    def finalize(self):
+        """Build the device metadata (reference ``finalize``: host->device
+        copy of the packed descriptors)."""
+        T, S, B = self.token_budget, self.max_seqs, self.max_blocks
+        bs = self.block_size
+        token_ids = np.zeros((T,), np.int32)
+        token_slot = np.zeros((T,), np.int32)
+        token_pos = np.zeros((T,), np.int32)
+        kv_dest = np.full((T,), TRASH * bs, np.int32)  # pads -> trash block
+        block_tables = np.full((S, B), TRASH, np.int32)
+        context_lens = np.zeros((S,), np.int32)
+        logits_idx = np.zeros((S,), np.int32)
+        n_valid = len(self._seqs)
+
+        cursor = 0
+        for slot, (seq, chunk) in enumerate(zip(self._seqs, self._chunks)):
+            n = len(chunk)
+            pos = np.arange(seq.seen_tokens, seq.seen_tokens + n, dtype=np.int32)
+            token_ids[cursor:cursor + n] = chunk
+            token_slot[cursor:cursor + n] = slot
+            token_pos[cursor:cursor + n] = pos
+            blocks = np.asarray(seq.blocks, np.int32)
+            if len(blocks) > B:
+                raise RuntimeError(
+                    f"sequence {seq.uid} exceeds max_blocks {B}")
+            block_tables[slot, :len(blocks)] = blocks
+            kv_dest[cursor:cursor + n] = blocks[pos // bs] * bs + pos % bs
+            context_lens[slot] = seq.seen_tokens + n
+            logits_idx[slot] = cursor + n - 1
+            cursor += n
+
+        return {
+            "token_ids": token_ids, "token_slot": token_slot,
+            "token_pos": token_pos, "kv_dest": kv_dest,
+            "block_tables": block_tables, "context_lens": context_lens,
+            "logits_idx": logits_idx, "n_valid": np.int32(n_valid),
+        }
+
+    @property
+    def sequences(self) -> List[DSSequenceDescriptor]:
+        return list(self._seqs)
+
+    @property
+    def chunk_sizes(self) -> List[int]:
+        return [len(c) for c in self._chunks]

@@ -1,0 +1,27 @@
+"""Sequence bookkeeping (reference:
+inference/v2/ragged/sequence_descriptor.py ``DSSequenceDescriptor``)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+
+@dataclasses.dataclass
+class DSSequenceDescriptor:
+    uid: int
+    seen_tokens: int = 0            # tokens whose KV is already cached
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    pending: List[int] = dataclasses.field(default_factory=list)
+    # tokens awaiting scheduling (prompt remainder under SplitFuse)
+    done: bool = False
+
+    @property
+    def cur_allocated_blocks(self) -> int:
+        return len(self.blocks)
+
+    def tokens_needed_capacity(self, new_tokens: int, block_size: int) -> int:
+        """Blocks that must be allocated to hold ``new_tokens`` more."""
+        total = self.seen_tokens + new_tokens
+        needed = -(-total // block_size)  # ceil
+        return max(0, needed - len(self.blocks))
