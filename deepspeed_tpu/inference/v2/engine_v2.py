@@ -1,0 +1,215 @@
+"""FastGen continuous-batching engine (reference: inference/v2/engine_v2.py
+``InferenceEngineV2`` — ``put:107`` / ``query:153`` / ``can_schedule:181`` /
+``flush:210``).
+
+TPU-native shape discipline: the ragged forward is ONE jitted program over
+static shapes ``(token_budget T, max_seqs S, max_blocks B)`` — exactly the
+property Dynamic SplitFuse gives the reference (fixed token budget per
+forward), which on TPU also means exactly one compilation.  Scheduling is
+host-side python (as in the reference); device work is the single jitted
+ragged step.
+
+``put`` runs one forward over whatever chunks fit the budget and returns the
+next-token logits per *fully scheduled* sequence; prompts longer than the
+remaining budget are chunked (SplitFuse) and continue on the next ``put``
+round via the sequence's ``pending`` queue.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
+    RaggedLlama,
+)
+from deepspeed_tpu.inference.v2.ragged import (DSStateManager,
+                                               RaggedBatchWrapper)
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class InferenceEngineV2:
+    """reference engine_v2.py:30."""
+
+    def __init__(self, model: RaggedLlama, params: Any,
+                 config: Optional[RaggedInferenceEngineConfig] = None):
+        self.config = config or RaggedInferenceEngineConfig()
+        sm_cfg = self.config.state_manager
+        kv_cfg = self.config.kv_cache
+        self.model = model
+        self.params = params
+        self.state_manager = DSStateManager(
+            sm_cfg, kv_cfg, num_layers=model.num_layers,
+            num_kv_heads=model.num_kv_heads, head_dim=model.head_dim,
+            dtype=getattr(model.config, "dtype", None))
+        self._max_blocks = -(-sm_cfg.max_context // kv_cfg.block_size)
+        self._batch = RaggedBatchWrapper(
+            token_budget=sm_cfg.max_ragged_batch_size,
+            max_seqs=sm_cfg.max_ragged_sequence_count,
+            max_blocks=self._max_blocks,
+            block_size=kv_cfg.block_size)
+        # donate the KV pool: the old cache is dead the moment
+        # state_manager.kv_cache.update() stores the new one, and donation
+        # lets XLA update the pool in place instead of copying it per step
+        self._step = jax.jit(model.__call__, donate_argnums=(1,))
+        log_dist(
+            f"InferenceEngineV2: token_budget={sm_cfg.max_ragged_batch_size} "
+            f"max_seqs={sm_cfg.max_ragged_sequence_count} "
+            f"kv_blocks={self.state_manager.allocator.num_blocks} "
+            f"block_size={kv_cfg.block_size}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    # Scheduling predicates (reference can_schedule:181 / query:153)
+    # ------------------------------------------------------------------ #
+    def query(self, uid: int) -> Dict[str, int]:
+        """Per-sequence status (reference ``query`` returns max lengths)."""
+        seq = self.state_manager.get_sequence(uid)
+        sm = self.state_manager
+        committed = (seq.seen_tokens + len(seq.pending)) if seq else 0
+        slack = (len(seq.blocks) * sm.block_size - committed) if seq else 0
+        headroom = min(sm.free_blocks * sm.block_size + max(slack, 0),
+                       self.config.state_manager.max_context - committed)
+        return {
+            "tracked": seq is not None,
+            "seen_tokens": seq.seen_tokens if seq else 0,
+            "pending_tokens": len(seq.pending) if seq else 0,
+            "free_blocks": sm.free_blocks,
+            "max_new_tokens": max(headroom, 0),
+        }
+
+    def can_schedule(self, uids: Sequence[int],
+                     lengths: Sequence[int]) -> bool:
+        """Would scheduling `lengths[i]` new tokens for `uids[i]` fit the
+        token budget, sequence slots, and free KV blocks right now?"""
+        if len(uids) > self._batch.max_seqs:
+            return False
+        if sum(lengths) > self._batch.token_budget:
+            return False
+        max_context = self.config.state_manager.max_context
+        blocks = 0
+        for uid, n in zip(uids, lengths):
+            seq = self.state_manager.get_sequence(uid)
+            have = (seq.seen_tokens + len(seq.pending)) if seq else 0
+            if have + n > max_context:
+                return False
+            if seq is None:
+                blocks += -(-n // self.state_manager.block_size)
+            else:
+                blocks += self.state_manager.blocks_needed(seq, n)
+        return blocks <= self.state_manager.free_blocks
+
+    # ------------------------------------------------------------------ #
+    # put (reference engine_v2.py:107)
+    # ------------------------------------------------------------------ #
+    def put(self, uids: Sequence[int],
+            tokens: Sequence[Sequence[int]]) -> Dict[int, np.ndarray]:
+        """Schedule new tokens for the given sequences and run forwards until
+        every scheduled chunk has been consumed.
+
+        Returns ``{uid: logits[vocab]}`` for the sequences whose LAST token
+        was processed this call (i.e. every uid — chunked prompts loop
+        internally until drained, as the reference's MII loop does across
+        ``put`` calls).
+        """
+        max_context = self.config.state_manager.max_context
+        for uid, toks in zip(uids, tokens):
+            if len(toks) == 0:
+                raise ValueError(f"put: empty token list for uid {uid}")
+            seq = self.state_manager.get_or_create_sequence(uid)
+            if seq.seen_tokens + len(seq.pending) + len(toks) > max_context:
+                raise RuntimeError(
+                    f"sequence {uid} would exceed max_context {max_context} "
+                    f"({seq.seen_tokens} seen + {len(seq.pending)} pending "
+                    f"+ {len(toks)} new); check can_schedule()/query() first")
+            seq.pending.extend(int(t) for t in toks)
+        results: Dict[int, np.ndarray] = {}
+        while self._has_pending(uids):
+            for uid, logits in self._run_one_batch(uids).items():
+                results[uid] = logits
+        return results
+
+    def _has_pending(self, uids) -> bool:
+        return any(self.state_manager.get_sequence(u) is not None
+                   and self.state_manager.get_sequence(u).pending
+                   for u in uids)
+
+    def _run_one_batch(self, uids) -> Dict[int, np.ndarray]:
+        """Build one ragged batch under the token budget (SplitFuse
+        chunking), run the jitted step, and return logits for slots whose
+        pending queue drained."""
+        sm = self.state_manager
+        self._batch.clear()
+        scheduled: List[int] = []
+        drained: List[bool] = []
+        for uid in uids:
+            seq = sm.get_sequence(uid)
+            if seq is None or not seq.pending:
+                continue
+            room = self._batch.token_budget - self._batch.current_tokens
+            if room <= 0 or self._batch.current_sequences >= \
+                    self._batch.max_seqs:
+                break
+            chunk = seq.pending[:room]               # Dynamic SplitFuse
+            sm.maybe_allocate_kv(seq, len(chunk))
+            self._batch.insert_sequence(seq, np.asarray(chunk, np.int32))
+            scheduled.append(uid)
+            drained.append(len(chunk) == len(seq.pending))
+        if not scheduled:
+            return {}
+
+        meta = self._batch.finalize()
+        device_meta = {k: jnp.asarray(v) for k, v in meta.items()
+                       if k != "n_valid"}
+        logits, new_cache = self._step(self.params, sm.kv_cache.cache,
+                                       device_meta)
+        sm.kv_cache.update(new_cache)
+
+        out: Dict[int, np.ndarray] = {}
+        logits_host = None
+        for slot, (uid, done) in enumerate(zip(scheduled, drained)):
+            seq = sm.get_sequence(uid)
+            n = self._batch.chunk_sizes[slot]
+            seq.seen_tokens += n
+            del seq.pending[:n]
+            if done:
+                if logits_host is None:
+                    logits_host = np.asarray(
+                        jax.device_get(logits), np.float32)
+                out[uid] = logits_host[slot]
+        return out
+
+    # ------------------------------------------------------------------ #
+    # flush (reference engine_v2.py:210)
+    # ------------------------------------------------------------------ #
+    def flush(self, uids: Sequence[int]) -> None:
+        self.state_manager.flush(uids)
+
+    # ------------------------------------------------------------------ #
+    # Convenience generation loop (the role MII plays above the reference
+    # engine: repeated put() of one token per live sequence)
+    # ------------------------------------------------------------------ #
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None,
+                 uids: Optional[Sequence[int]] = None) -> List[np.ndarray]:
+        if uids is None:
+            uids = list(range(len(prompts)))
+        outs: Dict[int, List[int]] = {u: [] for u in uids}
+        live = list(uids)
+        logits = self.put(uids, prompts)
+        for _ in range(max_new_tokens):
+            nxt = {u: int(np.argmax(logits[u])) for u in live}
+            for u in live:
+                outs[u].append(nxt[u])
+            live = [u for u in live
+                    if not (eos_token_id is not None
+                            and nxt[u] == eos_token_id)]
+            if not live:
+                break
+            logits = self.put(live, [[nxt[u]] for u in live])
+        self.flush(uids)
+        return [np.asarray(outs[u], np.int32) for u in uids]

@@ -1,0 +1,8 @@
+"""Inference v2 model implementations (reference:
+inference/v2/model_implementations/)."""
+
+from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
+    RaggedLlama,
+)
+
+__all__ = ["RaggedLlama"]

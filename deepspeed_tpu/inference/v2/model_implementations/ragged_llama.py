@@ -1,0 +1,163 @@
+"""Ragged (paged-KV) Llama forward for the FastGen engine.
+
+Reference analog: ``inference/v2/model_implementations/llama_v2`` built on
+``DSTransformerModelBase`` (inference_transformer_base.py:47), whose layer
+loop calls the CUDA ragged kernels (★linear_blocked_kv_rotary → ★blocked_flash
+→ cutlass GEMMs, SURVEY §3.5).
+
+TPU-native design: ONE jitted program consumes the packed token buffer that
+:class:`RaggedBatchWrapper.finalize` builds (static shapes: token budget T,
+max sequences S, block-table width B) and the flat paged KV pool from
+:class:`BlockedKVCache`:
+
+* token embeddings / projections / MLP run over the flat ``[T, H]`` buffer —
+  ragged batching is free on the MXU because tokens from different sequences
+  are just rows of the same matmul;
+* KV writes are one ``scatter`` to ``kv_dest`` (pad lanes write to the trash
+  block — no branches);
+* attention gathers each slot's context through its block table and masks
+  ``key_pos <= token_pos`` — since block tables are append-ordered, context
+  index == absolute position, so no extra position metadata is needed.
+  This is the XLA reference path; a Pallas paged-attention kernel can consume
+  the identical layout.
+
+The param tree is EXACTLY :class:`models.llama.LlamaForCausalLM`'s, so v1 and
+v2 engines share checkpoints and the continuous-batching correctness test can
+compare the two token-for-token.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import LlamaConfig, apply_rotary
+
+
+def _rms_norm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)
+            * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _paged_attention(q, k_pool, v_pool, batch, block_size):
+    """XLA paged attention over the blocked KV pool.
+
+    q: [T, H, D]; k_pool/v_pool: [num_blocks*bs, Hkv, D].
+    Returns [T, H, D].
+    """
+    block_tables = batch["block_tables"]          # [S, B]
+    token_slot = batch["token_slot"]              # [T]
+    token_pos = batch["token_pos"]                # [T]
+    S, B = block_tables.shape
+    C = B * block_size
+    h = q.shape[1]
+    hkv = k_pool.shape[1]
+
+    # Gather each slot's context: [S, C, Hkv, D].  Context index == absolute
+    # position because block tables are append-ordered.
+    flat_idx = (block_tables[:, :, None] * block_size
+                + jnp.arange(block_size, dtype=jnp.int32)[None, None, :]
+                ).reshape(S, C)
+    k_ctx = k_pool[flat_idx]                      # [S, C, Hkv, D]
+    v_ctx = v_pool[flat_idx]
+
+    # Per-token context via slot gather: [T, C, Hkv, D].
+    k_t = k_ctx[token_slot]
+    v_t = v_ctx[token_slot]
+
+    group = h // hkv
+    qf = q.astype(jnp.float32)
+    kf = k_t.astype(jnp.float32)
+    # [T, H, D] x [T, C, Hkv, D] -> [T, H, C] (GQA: head h uses kv head h//g)
+    qg = qf.reshape(q.shape[0], hkv, group, q.shape[2])
+    scores = jnp.einsum("tkgd,tckd->tkgc", qg, kf) / jnp.sqrt(
+        jnp.float32(q.shape[-1]))
+    mask = (jnp.arange(C, dtype=jnp.int32)[None, :]
+            <= token_pos[:, None])                # [T, C]
+    scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("tkgc,tckd->tkgd", probs, v_t.astype(jnp.float32))
+    return out.reshape(q.shape).astype(q.dtype)
+
+
+class RaggedLlama:
+    """Callable ragged forward bound to a :class:`LlamaConfig`."""
+
+    def __init__(self, config: LlamaConfig, block_size: int):
+        self.config = config
+        self.block_size = block_size
+
+    @property
+    def num_layers(self):
+        return self.config.num_hidden_layers
+
+    @property
+    def num_kv_heads(self):
+        return self.config.num_key_value_heads
+
+    @property
+    def head_dim(self):
+        return self.config.head_dim
+
+    def __call__(self, params: Dict[str, Any], kv_cache: Dict[str, Any],
+                 batch: Dict[str, jax.Array]):
+        """Run one ragged forward.
+
+        Returns ``(logits [S, vocab], new_kv_cache)`` where row ``s`` holds
+        the logits of slot ``s``'s LAST scheduled token.
+        """
+        cfg = self.config
+        m = params["model"]
+        dt = cfg.dtype
+        token_ids = batch["token_ids"]            # [T]
+        token_pos = batch["token_pos"]            # [T]
+        kv_dest = batch["kv_dest"]                # [T]
+
+        x = m["embed_tokens"]["embedding"].astype(dt)[token_ids]   # [T, H]
+        h, hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        cos, sin = _rotary(token_pos, d, cfg.rope_theta)
+        new_cache = {}
+        for i in range(cfg.num_hidden_layers):
+            lp = m[f"layers_{i}"]
+            attn, mlp = lp["self_attn"], lp["mlp"]
+            xa = _rms_norm(x, lp["input_layernorm"]["scale"],
+                           cfg.rms_norm_eps)
+            q = (xa @ attn["q_proj"]["kernel"].astype(dt)).reshape(-1, h, d)
+            k = (xa @ attn["k_proj"]["kernel"].astype(dt)).reshape(-1, hkv, d)
+            v = (xa @ attn["v_proj"]["kernel"].astype(dt)).reshape(-1, hkv, d)
+            # apply_rotary broadcasts over [T, H, D] with cos/sin [T, 1, D/2]
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+            layer = kv_cache[f"layer_{i}"]
+            k_pool = layer["k"].at[kv_dest].set(k.astype(layer["k"].dtype))
+            v_pool = layer["v"].at[kv_dest].set(v.astype(layer["v"].dtype))
+            new_cache[f"layer_{i}"] = {"k": k_pool, "v": v_pool}
+            out = _paged_attention(q, k_pool, v_pool, batch, self.block_size)
+            out = out.reshape(-1, h * d) @ attn["o_proj"]["kernel"].astype(dt)
+            x = x + out
+            xm = _rms_norm(x, lp["post_attention_layernorm"]["scale"],
+                           cfg.rms_norm_eps)
+            gate = xm @ mlp["gate_proj"]["kernel"].astype(dt)
+            up = xm @ mlp["up_proj"]["kernel"].astype(dt)
+            x = x + (jax.nn.silu(gate) * up) @ \
+                mlp["down_proj"]["kernel"].astype(dt)
+        x = _rms_norm(x, m["norm"]["scale"], cfg.rms_norm_eps)
+        if cfg.tie_word_embeddings:
+            logits = x @ m["embed_tokens"]["embedding"].astype(dt).T
+        else:
+            logits = x @ params["lm_head"]["kernel"].astype(dt)
+        # ★logits_gather analog: only each slot's last token (SURVEY §3.5)
+        return logits[batch["logits_idx"]], new_cache
+
+
+def _rotary(positions, head_dim, theta):
+    """positions: [T] -> (cos, sin): [T, 1, D/2] fp32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                                / head_dim))
+    angles = positions[:, None].astype(jnp.float32) * inv_freq   # [T, D/2]
+    return jnp.cos(angles)[:, None, :], jnp.sin(angles)[:, None, :]

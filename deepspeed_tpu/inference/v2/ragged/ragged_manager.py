@@ -1,0 +1,92 @@
+"""Sequence/KV state manager (reference: inference/v2/ragged/ragged_manager.py
+``DSStateManager`` — tracks live sequences and owns the blocked KV cache).
+
+Host-side bookkeeping only: which sequences are live, how many KV blocks each
+owns, and whether a proposed ragged batch fits the cache.  All device state
+lives in :class:`BlockedKVCache` and is threaded functionally through the
+jitted forward by the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig,
+                                                  KVCacheConfig)
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import (
+    DSSequenceDescriptor,
+)
+
+
+class DSStateManager:
+    """reference ragged_manager.py:DSStateManager."""
+
+    def __init__(self, config: DSStateManagerConfig,
+                 kv_config: KVCacheConfig,
+                 num_layers: int, num_kv_heads: int, head_dim: int,
+                 dtype=None):
+        self.config = config
+        self.kv_config = kv_config
+        self.block_size = kv_config.block_size
+        num_blocks = kv_config.num_blocks
+        if num_blocks is None:
+            # enough for max_ragged_sequence_count sequences at max_context,
+            # +1 for the trash block
+            per_seq = -(-config.max_context // self.block_size)
+            num_blocks = config.max_ragged_sequence_count * per_seq + 1
+        self.allocator = BlockedAllocator(num_blocks)
+        kwargs = {}
+        if dtype is not None or kv_config.cache_dtype is not None:
+            kwargs["dtype"] = kv_config.cache_dtype or dtype
+        self.kv_cache = BlockedKVCache(num_layers, num_blocks, self.block_size,
+                                       num_kv_heads, head_dim, **kwargs)
+        self._seqs: Dict[int, DSSequenceDescriptor] = {}
+
+    # ------------------------------------------------------------------ #
+    # Sequence tracking (reference get_or_create_sequence / flush)
+    # ------------------------------------------------------------------ #
+    @property
+    def n_tracked_sequences(self) -> int:
+        return len(self._seqs)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    def get_sequence(self, uid: int) -> Optional[DSSequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:
+        seq = self._seqs.get(uid)
+        if seq is None:
+            if len(self._seqs) >= self.config.max_tracked_sequences:
+                raise RuntimeError(
+                    f"too many tracked sequences "
+                    f"({self.config.max_tracked_sequences})")
+            seq = DSSequenceDescriptor(uid=uid)
+            self._seqs[uid] = seq
+        return seq
+
+    def blocks_needed(self, seq: DSSequenceDescriptor, new_tokens: int) -> int:
+        return seq.tokens_needed_capacity(new_tokens, self.block_size)
+
+    def maybe_allocate_kv(self, seq: DSSequenceDescriptor,
+                          new_tokens: int) -> None:
+        """reference engine_v2.py maybe_allocate_kv: grow the block table."""
+        need = self.blocks_needed(seq, new_tokens)
+        if need:
+            seq.blocks.extend(self.allocator.allocate(need))
+
+    def flush_sequence(self, uid: int) -> None:
+        """reference flush: release a finished sequence's KV blocks."""
+        seq = self._seqs.pop(uid, None)
+        if seq is None:
+            raise ValueError(f"unknown sequence uid {uid}")
+        if seq.blocks:
+            self.allocator.free(seq.blocks)
+
+    def flush(self, uids: Iterable[int]) -> None:
+        for uid in uids:
+            self.flush_sequence(uid)
