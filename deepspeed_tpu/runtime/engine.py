@@ -160,6 +160,22 @@ class DeepSpeedEngine:
             self.zero_stage, self.topology,
             param_persistence_threshold=self.config.zero_config.param_persistence_threshold
             if self.zero_stage >= 3 else 0)
+
+        # offload (reference zero/parameter_offload.py; OffloadPP ratio) ----
+        from deepspeed_tpu.runtime.zero.offload import validate_offload_config
+
+        zc = self.config.zero_config
+        self._offload_device = validate_offload_config(
+            zc.offload_optimizer, self.zero_stage, "offload_optimizer")
+        self._offload_ratio = (zc.offload_optimizer.ratio
+                               if self._offload_device else 0.0)
+        self._offload_plan = None  # built with the shardings
+        if zc.offload_param is not None and \
+                zc.offload_param.device not in (None, "none"):
+            logger.warning(
+                "offload_param is accepted but NOT implemented yet: "
+                "compute-precision params stay on device (stage-3 keeps them "
+                "sharded); host/NVMe param offload lands with the AIO swapper")
         self.base_param_specs = base_param_specs
         if self.base_param_specs is None:
             self.base_param_specs = getattr(model, "partition_rules", None)
@@ -273,6 +289,15 @@ class DeepSpeedEngine:
             "acc_grads": grad_s,
             "loss_scale": scalar, "good_steps": scalar, "hysteresis": scalar,
         }
+        if self._offload_device:
+            from deepspeed_tpu.runtime.zero.offload import OffloadPlan
+
+            self._offload_plan = OffloadPlan(params_shapes,
+                                             ratio=self._offload_ratio)
+            log_dist(
+                f"ZeRO-Offload: optimizer state -> host "
+                f"({self._offload_plan.fraction:.0%} of elements, "
+                f"ratio={self._offload_ratio})", ranks=[0])
         return self._shardings
 
     def _state_shardings(self):
@@ -287,6 +312,8 @@ class DeepSpeedEngine:
             lambda p: self._make_state(
                 jax.tree.map(lambda x: x.astype(jnp.float32), p)),
             out_shardings=dict(sh))(host_params)
+        if self._offload_plan is not None:
+            self._offload_transfer(to_host=True)
 
     def initialize_parameters(self, *sample_args, seed: Optional[int] = None):
         """Construct params directly sharded (the reference's ``zero.Init``
@@ -303,6 +330,8 @@ class DeepSpeedEngine:
             return self._make_state(params32)
 
         self.state = jax.jit(build, out_shardings=dict(sh))(rng, *sample_args)
+        if self._offload_plan is not None:
+            self._offload_transfer(to_host=True)
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
         log_dist(f"initialized {n_params/1e6:.2f}M parameters", ranks=[0])
         return self.state
@@ -354,13 +383,13 @@ class DeepSpeedEngine:
         return float(self.config.gradient_accumulation_steps)
 
     def _build_micro(self):
+        """The micro program reads ONLY (params, acc_grads, loss_scale) —
+        master weights and optimizer moments never flow through it, so with
+        offload enabled they stay host-resident across micro-steps."""
         gas = self._grad_accum_divisor()
         sh = self._state_shardings()
 
-        def micro(state, rng, *args):
-            params = state["params"]
-            scale = state["loss_scale"]
-
+        def micro(params, acc_grads, scale, rng, *args):
             def scaled_loss_fn(p):
                 out = self._apply_fn(p, *args, rng=rng, train=True)
                 loss, _aux = self._loss_from_outputs(out, args)
@@ -369,15 +398,13 @@ class DeepSpeedEngine:
             grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
             (_, loss), grads = grad_fn(params)
             acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
-                               state["acc_grads"], grads)
-            new_state = dict(state)
-            new_state["acc_grads"] = acc
-            return new_state, loss
+                               acc_grads, grads)
+            return acc, loss
 
         self._jit_micro = jax.jit(
             micro,
-            donate_argnums=(0,),
-            out_shardings=(dict(sh), NamedSharding(self.mesh, P())))
+            donate_argnums=(1,),
+            out_shardings=(sh["acc_grads"], NamedSharding(self.mesh, P())))
 
     def _build_apply(self):
         sh = self._state_shardings()
@@ -476,7 +503,9 @@ class DeepSpeedEngine:
             return self._jit_eval(self.state["params"], rng, *args)
         if self._jit_micro is None:
             self._build_micro()
-        self.state, loss = self._jit_micro(self.state, rng, *args)
+        self.state["acc_grads"], loss = self._jit_micro(
+            self.state["params"], self.state["acc_grads"],
+            self.state["loss_scale"], rng, *args)
         self._last_loss = loss
         self._seen_backward = False
         return loss
@@ -506,6 +535,17 @@ class DeepSpeedEngine:
             return [float(self.lr_scheduler.lr_fn(self.global_steps))]
         return [self._base_lr]
 
+    def _offload_transfer(self, to_host: bool):
+        """Stream offloaded master/opt leaves host<->device at the
+        optimizer-step boundary (the reference's CPU-Adam H2D/D2H cadence,
+        zero/parameter_offload.py)."""
+        plan, sh = self._offload_plan, self._shardings
+        self.state["master"] = plan.place(self.state["master"], sh["master"],
+                                          to_host=to_host)
+        self.state["opt"] = {
+            k: plan.place(v, sh["opt"][k], to_host=to_host)
+            for k, v in self.state["opt"].items()}
+
     def step(self):
         """Optimizer step at gradient-accumulation boundaries.
         (reference engine.step:2111 -> _take_model_step:2045)"""
@@ -514,7 +554,11 @@ class DeepSpeedEngine:
         if self._jit_apply is None:
             self._build_apply()
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        if self._offload_plan is not None:
+            self._offload_transfer(to_host=False)
         self.state, gnorm, overflow = self._jit_apply(self.state, lr)
+        if self._offload_plan is not None:
+            self._offload_transfer(to_host=True)
         self.global_steps += 1
         if self.fp16_enabled:
             # overflow is tiny; fetching it keeps skipped_steps accurate
@@ -606,6 +650,8 @@ class DeepSpeedEngine:
         path, client_state = load_engine_state(
             self, load_dir, tag,
             load_optimizer_states=load_optimizer_states and not load_module_only)
+        if self._offload_plan is not None:
+            self._offload_transfer(to_host=True)  # restore host residency
         if client_state:
             self.global_steps = int(client_state.get("global_steps", 0))
             self.global_samples = int(client_state.get("global_samples", 0))
