@@ -1,7 +1,9 @@
 from deepspeed_tpu.checkpoint.engine import (
+    AsyncCheckpointEngine,
     CheckpointEngine,
     load_engine_state,
     save_engine_state,
 )
 
-__all__ = ["CheckpointEngine", "save_engine_state", "load_engine_state"]
+__all__ = ["AsyncCheckpointEngine", "CheckpointEngine", "save_engine_state",
+           "load_engine_state"]

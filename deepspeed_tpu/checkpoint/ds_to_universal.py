@@ -1,0 +1,133 @@
+"""Universal checkpoint converter (reference: checkpoint/ds_to_universal.py:286
+``main`` — zero shards -> per-parameter fp32 slices -> reload under any
+topology; loader universal_checkpoint.py:12 ``load_hp_checkpoint_state``).
+
+The sharded format (:mod:`.sharded`) is already topology-agnostic, so the
+universal layout here is a *materialised* per-parameter view of it —
+the reference's ``<out>/zero/<param>/fp32.*`` directory tree::
+
+    <out>/zero/<param_path>/fp32.npy          # full fp32 master weight
+    <out>/zero/<param_path>/<moment>.npy      # optimizer moments (exp_avg...)
+    <out>/universal_meta.json                 # scalars + source tag
+
+Use cases match the reference: archival (no engine needed to read a param),
+interop, and loading under a topology whose engine wants plain arrays.
+``load_universal_into_engine`` re-shards on the fly (save TP=2 -> load TP=4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.checkpoint import sharded
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.tensors import flat_dict_to_tree, tree_to_flat_dict
+
+
+def _resolve_tag_dir(ckpt_dir: str, tag: Optional[str]) -> str:
+    if tag is None:
+        latest = os.path.join(ckpt_dir, "latest")
+        if not os.path.exists(latest):
+            raise FileNotFoundError(f"no 'latest' file in {ckpt_dir}")
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = os.path.join(ckpt_dir, str(tag))
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"checkpoint dir {path} not found")
+    return path
+
+
+def convert(ckpt_dir: str, out_dir: str, tag: Optional[str] = None) -> str:
+    """Sharded checkpoint -> universal per-param directory tree."""
+    src = _resolve_tag_dir(ckpt_dir, tag)
+    info = sharded.read_index(src)
+    os.makedirs(out_dir, exist_ok=True)
+    n = 0
+    for leaf, rec in info["leaves"].items():
+        # leaf paths look like master/<param>, opt/<moment>/<param>,
+        # acc_grads/<param>
+        parts = leaf.split("/")
+        if parts[0] == "master":
+            param, fname = "/".join(parts[1:]), "fp32"
+        elif parts[0] == "opt":
+            param, fname = "/".join(parts[2:]), parts[1]
+        else:
+            continue  # grads are transient; universal keeps weights+moments
+        d = os.path.join(out_dir, "zero", param)
+        os.makedirs(d, exist_ok=True)
+        np.save(os.path.join(d, f"{fname}.npy"),
+                sharded.assemble_leaf(src, rec))
+        n += 1
+    meta = {"source": src,
+            "scalars": {k: v.tolist() for k, v in info["scalars"].items()}}
+    with open(os.path.join(out_dir, "universal_meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    logger.info(f"ds_to_universal: wrote {n} arrays to {out_dir}")
+    return out_dir
+
+
+def load_universal_into_engine(engine, universal_dir: str,
+                               load_optimizer_states: bool = True) -> None:
+    """Load a universal checkpoint into an engine of ANY topology."""
+    sh = engine._state_shardings()
+    zero_dir = os.path.join(universal_dir, "zero")
+
+    def place(template, shardings, fname) -> Dict:
+        flat_t = tree_to_flat_dict(template)
+        flat_s = tree_to_flat_dict(shardings)
+        out = {}
+        for name, leaf in flat_t.items():
+            p = os.path.join(zero_dir, name, f"{fname}.npy")
+            arr = np.load(p)
+            if tuple(arr.shape) != tuple(leaf.shape):
+                raise ValueError(f"shape mismatch for {name}: "
+                                 f"{arr.shape} vs {tuple(leaf.shape)}")
+            out[name] = jax.device_put(arr, flat_s[name])
+        return flat_dict_to_tree(out, template)
+
+    new_state = dict(engine.state)
+    new_state["master"] = place(engine.state["master"], sh["master"], "fp32")
+    if load_optimizer_states:
+        new_state["opt"] = {
+            k: place(engine.state["opt"][k], sh["opt"][k], k)
+            for k in engine.state["opt"]}
+    meta_file = os.path.join(universal_dir, "universal_meta.json")
+    if os.path.exists(meta_file):
+        with open(meta_file) as f:
+            meta = json.load(f)
+        for name, val in meta.get("scalars", {}).items():
+            if name in sh:
+                new_state[name] = jax.device_put(
+                    np.asarray(val,
+                               dtype=np.asarray(
+                                   jax.device_get(
+                                       engine.state[name])).dtype),
+                    sh[name])
+    import jax.numpy as jnp  # noqa: F401
+
+    new_state["params"] = jax.jit(
+        lambda m: jax.tree.map(
+            lambda x: x.astype(engine.compute_dtype), m),
+        out_shardings=sh["params"])(new_state["master"])
+    engine.state = new_state
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Convert a deepspeed_tpu sharded checkpoint to the "
+                    "universal per-parameter format")
+    p.add_argument("--input_folder", required=True)
+    p.add_argument("--output_folder", required=True)
+    p.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    convert(args.input_folder, args.output_folder, args.tag)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,32 +1,37 @@
 """Checkpoint save/load (reference: runtime/checkpoint_engine/
 checkpoint_engine.py:9 pluggable engines + runtime/engine.py:3021
-``save_checkpoint`` / :2672 ``load_checkpoint``).
+``save_checkpoint`` / :2672 ``load_checkpoint`` / per-rank ZeRO shards
+``:3423``).
 
-Directory layout mirrors the reference so tooling expectations transfer::
+Directory layout::
 
-    <save_dir>/<tag>/mp_rank_00_model_states.npz     # fp32 master weights
-    <save_dir>/<tag>/zero_pp_rank_0_mp_rank_00_optim_states.npz
+    <save_dir>/<tag>/zero_pp_rank_{p}_mp_rank_00_states.npz  # per-process
     <save_dir>/<tag>/client_state.json
-    <save_dir>/latest                                 # tag pointer
+    <save_dir>/latest                                        # tag pointer
 
-Arrays are gathered to host as numpy: single-process via ``device_get``,
-multi-host via ``multihost_utils.process_allgather`` (collective — all
-processes participate) with process 0 as the sole file writer and a barrier
-before the ``latest`` tag is published. The pluggable ``CheckpointEngine``
-interface matches the reference so an async/Nebula-style engine can swap in.
+Scalable by construction: each process writes only its addressable shards
+(host RAM and I/O are O(model/processes)); pieces carry their global slice
+coordinates so a checkpoint saved under one topology loads under ANY other
+(ZeRO stage, TP width, process count) — see :mod:`.sharded`.  The pluggable
+``CheckpointEngine`` interface matches the reference so the async engine (the
+Nebula analog, runtime/checkpoint_engine/nebula_checkpoint_engine.py:20) can
+swap in; ``commit`` is the durability barrier before the ``latest`` tag is
+published.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
+from deepspeed_tpu.checkpoint import sharded
 from deepspeed_tpu.utils.logging import log_dist, logger
-from deepspeed_tpu.utils.tensors import flat_dict_to_tree, tree_to_flat_dict
+from deepspeed_tpu.utils.tensors import flat_dict_to_tree
 
 
 class CheckpointEngine:
@@ -49,20 +54,32 @@ class CheckpointEngine:
         return True
 
 
-def _to_numpy_flat(tree) -> Dict[str, np.ndarray]:
-    """Full host copy of a (possibly sharded) tree.
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Background-thread writer (reference: the async Nebula engine,
+    runtime/checkpoint_engine/nebula_checkpoint_engine.py:20).
 
-    Multi-host: ``jax.device_get`` raises on arrays spanning non-addressable
-    devices, so gather via ``multihost_utils.process_allgather`` — every
-    process gets the full value; only process 0 writes files.
-    """
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    ``save`` returns as soon as the host copy is handed to the writer thread;
+    ``commit`` blocks until every pending write is durable, so the ``latest``
+    tag is never published ahead of the data."""
 
-        host = multihost_utils.process_allgather(tree, tiled=True)
-    else:
-        host = jax.device_get(tree)
-    return {k: np.asarray(v) for k, v in tree_to_flat_dict(host).items()}
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        self._pending: list = []
+        self._lock = threading.Lock()
+
+    def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
+        t = threading.Thread(target=np.savez, args=(path,),
+                             kwargs=state_dict, daemon=True)
+        t.start()
+        with self._lock:
+            self._pending.append(t)
+
+    def commit(self, tag: str) -> bool:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for t in pending:
+            t.join()
+        return True
 
 
 def _is_writer() -> bool:
@@ -73,43 +90,35 @@ def save_engine_state(engine, save_dir: str, tag: str,
                       client_state: Dict[str, Any],
                       save_latest: bool = True,
                       checkpoint_engine: Optional[CheckpointEngine] = None) -> str:
-    ce = checkpoint_engine or CheckpointEngine()
+    ce = checkpoint_engine or getattr(engine, "checkpoint_engine", None) \
+        or CheckpointEngine()
     path = os.path.join(save_dir, str(tag))
-    if _is_writer():
-        os.makedirs(path, exist_ok=True)
+    os.makedirs(path, exist_ok=True)  # every process may race; exist_ok
     ce.create(tag)
 
     state = engine.state
-    # Gathers are collective — every process participates; only process 0
-    # writes (shared-filesystem safe).
-    model_flat = _to_numpy_flat(state["master"])
-    optim = {
-        "opt": state["opt"],
-        "acc_grads": state["acc_grads"],
-    }
-    optim_flat = _to_numpy_flat(optim)
-    for name in ("step", "opt_step", "loss_scale", "good_steps", "hysteresis"):
-        if name in state:
-            optim_flat[f"__{name}__"] = np.asarray(jax.device_get(state[name]))
-
+    scalars = {name: np.asarray(jax.device_get(state[name]))
+               for name in ("step", "opt_step", "loss_scale", "good_steps",
+                            "hysteresis") if name in state}
+    tree = {"master": state["master"], "opt": state["opt"],
+            "acc_grads": state["acc_grads"]}
+    sharded.save_process_shards(tree, path, scalars=scalars,
+                                checkpoint_engine=ce)
     if _is_writer():
-        ce.save(model_flat, os.path.join(path, "mp_rank_00_model_states.npz"))
-        ce.save(optim_flat,
-                os.path.join(path, "zero_pp_rank_0_mp_rank_00_optim_states.npz"))
         with open(os.path.join(path, "client_state.json"), "w") as f:
             json.dump(client_state, f, indent=2, default=str)
 
-    # all processes reach this point before the tag is published
     from deepspeed_tpu import comm as dist
 
+    # every process's shards written + durable before the tag is published
     dist.barrier()
+    ce.commit(tag)
     if save_latest and _is_writer():
         with open(os.path.join(save_dir, "latest"), "w") as f:
             f.write(str(tag))
-    # second barrier: no process returns until the tag is published, so an
-    # immediate collective load(tag=None) sees the same checkpoint everywhere
+    # no process returns until the tag is published, so an immediate
+    # collective load(tag=None) sees the same checkpoint everywhere
     dist.barrier()
-    ce.commit(tag)
     return path
 
 
@@ -126,9 +135,8 @@ def load_engine_state(engine, load_dir: str, tag: Optional[str] = None,
         with open(latest) as f:
             tag = f.read().strip()
     path = os.path.join(load_dir, str(tag))
-    model_file = os.path.join(path, "mp_rank_00_model_states.npz")
-    if not os.path.exists(model_file):
-        logger.warning(f"checkpoint {model_file} not found")
+    if not os.path.isdir(path):
+        logger.warning(f"checkpoint dir {path} not found")
         return None, {}
 
     if engine.state is None:
@@ -137,17 +145,75 @@ def load_engine_state(engine, load_dir: str, tag: Optional[str] = None,
             "initialize_parameters) before load_checkpoint")
 
     sh = engine._state_shardings()
-    model_flat = ce.load(model_file)
-    master = flat_dict_to_tree(model_flat, engine.state["master"])
-    master = jax.tree.map(
-        lambda arr, s: jax.device_put(np.asarray(arr), s), master, sh["master"])
-
     new_state = dict(engine.state)
-    new_state["master"] = master
+    try:
+        sharded._iter_shard_files(path)
+        has_shards = True
+    except FileNotFoundError:
+        has_shards = False
+
+    if has_shards:
+        if load_optimizer_states:
+            target = {"master": engine.state["master"],
+                      "opt": engine.state["opt"],
+                      "acc_grads": engine.state["acc_grads"]}
+            shard_sh = {"master": sh["master"], "opt": sh["opt"],
+                        "acc_grads": sh["acc_grads"]}
+            loaded, scalars = sharded.load_tree(path, target, shard_sh)
+            new_state.update(loaded)
+            for name, val in scalars.items():
+                if name in sh:
+                    new_state[name] = jax.device_put(val, sh[name])
+        else:
+            # module-only: reassemble just the master leaves
+            info = sharded.read_index(path)
+            master_keys = {k: v for k, v in info["leaves"].items()
+                           if k.startswith("master/")}
+            from deepspeed_tpu.utils.tensors import tree_to_flat_dict
+
+            flat_target = tree_to_flat_dict(engine.state["master"])
+            flat_sh = tree_to_flat_dict(sh["master"])
+            out = {}
+            for name, leaf in flat_target.items():
+                rec = master_keys.get(f"master/{name}")
+                if rec is None:
+                    raise KeyError(f"checkpoint missing master/{name}")
+                out[name] = jax.device_put(
+                    sharded.assemble_leaf(path, rec), flat_sh[name])
+            new_state["master"] = flat_dict_to_tree(
+                out, engine.state["master"])
+    else:
+        new_state = _load_legacy_consolidated(
+            engine, path, ce, sh, new_state, load_optimizer_states)
+        if new_state is None:
+            return None, {}
+
     new_state["params"] = jax.jit(
         lambda m: jax.tree.map(lambda x: x.astype(engine.compute_dtype), m),
-        out_shardings=sh["params"])(master)
+        out_shardings=sh["params"])(new_state["master"])
+    engine.state = new_state
 
+    client_state: Dict[str, Any] = {}
+    cs_file = os.path.join(path, "client_state.json")
+    if os.path.exists(cs_file):
+        with open(cs_file) as f:
+            client_state = json.load(f)
+    log_dist(f"Loaded checkpoint from {path}", ranks=[0])
+    return path, client_state
+
+
+def _load_legacy_consolidated(engine, path, ce, sh, new_state,
+                              load_optimizer_states):
+    """Round-1 layout: consolidated mp_rank_00_model_states.npz."""
+    model_file = os.path.join(path, "mp_rank_00_model_states.npz")
+    if not os.path.exists(model_file):
+        logger.warning(f"checkpoint {model_file} not found")
+        return None
+    model_flat = ce.load(model_file)
+    master = flat_dict_to_tree(model_flat, engine.state["master"])
+    new_state["master"] = jax.tree.map(
+        lambda arr, s: jax.device_put(np.asarray(arr), s), master,
+        sh["master"])
     if load_optimizer_states:
         optim_file = os.path.join(
             path, "zero_pp_rank_0_mp_rank_00_optim_states.npz")
@@ -164,19 +230,12 @@ def load_engine_state(engine, load_dir: str, tag: Optional[str] = None,
             new_state["acc_grads"] = jax.tree.map(
                 lambda arr, s: jax.device_put(np.asarray(arr), s),
                 optim["acc_grads"], sh["acc_grads"])
-            for name, key in (("step", "__step__"), ("opt_step", "__opt_step__"),
+            for name, key in (("step", "__step__"),
+                              ("opt_step", "__opt_step__"),
                               ("loss_scale", "__loss_scale__"),
                               ("good_steps", "__good_steps__"),
                               ("hysteresis", "__hysteresis__")):
                 if key in scalars and name in sh:
                     new_state[name] = jax.device_put(
                         np.asarray(scalars[key]), sh[name])
-
-    engine.state = new_state
-    client_state: Dict[str, Any] = {}
-    cs_file = os.path.join(path, "client_state.json")
-    if os.path.exists(cs_file):
-        with open(cs_file) as f:
-            client_state = json.load(f)
-    log_dist(f"Loaded checkpoint from {path}", ranks=[0])
-    return path, client_state
+    return new_state

@@ -1,0 +1,72 @@
+"""Consolidate a sharded ZeRO checkpoint into full fp32 weights
+(reference: deepspeed/utils/zero_to_fp32.py, 587 LoC — the offline tool users
+run to get a plain state dict out of ZeRO shard files).
+
+No engine or device needed: reads the per-process shard files and
+reassembles each master weight at its global shape, one leaf at a time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.checkpoint import sharded
+from deepspeed_tpu.checkpoint.ds_to_universal import _resolve_tag_dir
+from deepspeed_tpu.utils.logging import logger
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        ckpt_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """reference zero_to_fp32.py:get_fp32_state_dict_from_zero_checkpoint."""
+    src = _resolve_tag_dir(ckpt_dir, tag)
+    info = sharded.read_index(src)
+    out: Dict[str, np.ndarray] = {}
+    for leaf, rec in info["leaves"].items():
+        if not leaf.startswith("master/"):
+            continue
+        out[leaf[len("master/"):]] = sharded.assemble_leaf(src, rec)
+    if not out:
+        raise ValueError(f"no master weights found under {src}")
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(
+        ckpt_dir: str, output_file: str, tag: Optional[str] = None) -> str:
+    """reference zero_to_fp32.py:convert_zero_checkpoint_to_fp32_state_dict —
+    writes a single consolidated ``.npz``."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+    np.savez(output_file, **sd)
+    total = sum(int(np.prod(v.shape)) for v in sd.values())
+    logger.info(f"zero_to_fp32: wrote {len(sd)} tensors "
+                f"({total/1e6:.2f}M params) to {output_file}")
+    return output_file
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Extract consolidated fp32 weights from a deepspeed_tpu "
+                    "ZeRO checkpoint")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(
+        args.checkpoint_dir, args.output_file, args.tag)
+
+
+if __name__ == "__main__":
+    main()
+
+
+def load_state_dict_from_zero_checkpoint(model_params, ckpt_dir,
+                                         tag: Optional[str] = None):
+    """reference zero_to_fp32.py:load_state_dict_from_zero_checkpoint —
+    returns a pytree shaped like ``model_params`` filled from the ckpt."""
+    from deepspeed_tpu.utils.tensors import flat_dict_to_tree
+
+    sd = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+    return flat_dict_to_tree(sd, model_params)
