@@ -65,11 +65,19 @@ class AsyncCheckpointEngine(CheckpointEngine):
     def __init__(self, config_params=None):
         super().__init__(config_params)
         self._pending: list = []
+        self._errors: list = []
         self._lock = threading.Lock()
 
+    def _write(self, path: str, state_dict: Dict[str, np.ndarray]) -> None:
+        try:
+            np.savez(path, **state_dict)
+        except BaseException as e:  # surfaced by commit()
+            with self._lock:
+                self._errors.append((path, e))
+
     def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
-        t = threading.Thread(target=np.savez, args=(path,),
-                             kwargs=state_dict, daemon=True)
+        t = threading.Thread(target=self._write, args=(path, state_dict),
+                             daemon=True)
         t.start()
         with self._lock:
             self._pending.append(t)
@@ -79,6 +87,13 @@ class AsyncCheckpointEngine(CheckpointEngine):
             pending, self._pending = self._pending, []
         for t in pending:
             t.join()
+        with self._lock:
+            errors, self._errors = self._errors, []
+        if errors:
+            path, exc = errors[0]
+            raise RuntimeError(
+                f"async checkpoint write failed for {path} "
+                f"(+{len(errors) - 1} more)") from exc
         return True
 
 
@@ -110,9 +125,10 @@ def save_engine_state(engine, save_dir: str, tag: str,
 
     from deepspeed_tpu import comm as dist
 
-    # every process's shards written + durable before the tag is published
-    dist.barrier()
+    # drain this process's writes, THEN barrier: every process's shards are
+    # durable before the tag is published (async engine included)
     ce.commit(tag)
+    dist.barrier()
     if save_latest and _is_writer():
         with open(os.path.join(save_dir, "latest"), "w") as f:
             f.write(str(tag))

@@ -53,6 +53,9 @@ from deepspeed_tpu.runtime.lr_schedules import LRScheduler, get_lr_schedule_fn
 from deepspeed_tpu.runtime.zero import ZeroShardings
 from deepspeed_tpu.ops.optimizers import OptimizerDef, get_optimizer
 from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import (FORWARD_MICRO_TIMER, STEP_MICRO_TIMER,
+                                       NoopTimer, SynchronizedWallClockTimer,
+                                       ThroughputTimer)
 
 BATCH_AXES = GROUP_ALIASES["dp"]  # ('data','expert')
 
@@ -227,6 +230,17 @@ class DeepSpeedEngine:
         from deepspeed_tpu.monitor.monitor import MonitorMaster
 
         self.monitor = MonitorMaster(self.config)
+
+        # timers / throughput / flops profiler (reference utils/timer.py:43,
+        # runtime/engine.py:140 EngineTimers, profiling/flops_profiler) -----
+        self.wall_clock_breakdown = lambda: self.config.wall_clock_breakdown
+        self.timers = (SynchronizedWallClockTimer()
+                       if self.config.wall_clock_breakdown else NoopTimer())
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.config.train_batch_size,
+            steps_per_output=self.config.steps_per_print)
+        self.flops_profiler = None
+        self._micro_in_shapes = None  # ShapeDtypeStructs for AOT cost analysis
 
         import deepspeed_tpu.comm as dist
 
@@ -503,9 +517,19 @@ class DeepSpeedEngine:
             return self._jit_eval(self.state["params"], rng, *args)
         if self._jit_micro is None:
             self._build_micro()
-        self.state["acc_grads"], loss = self._jit_micro(
-            self.state["params"], self.state["acc_grads"],
-            self.state["loss_scale"], rng, *args)
+        if self.micro_steps % self.config.gradient_accumulation_steps == 0:
+            self.tput_timer.start()
+        self.timers(FORWARD_MICRO_TIMER).start()
+        inputs = (self.state["params"], self.state["acc_grads"],
+                  self.state["loss_scale"], rng) + args
+        if self._micro_in_shapes is None:
+            self._micro_in_shapes = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
+                inputs)
+        self.state["acc_grads"], loss = self._jit_micro(*inputs)
+        self.timers(FORWARD_MICRO_TIMER).stop(
+            sync_obj=loss if self.config.wall_clock_breakdown else None)
         self._last_loss = loss
         self._seen_backward = False
         return loss
@@ -554,12 +578,25 @@ class DeepSpeedEngine:
         if self._jit_apply is None:
             self._build_apply()
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        self.timers(STEP_MICRO_TIMER).start()
         if self._offload_plan is not None:
             self._offload_transfer(to_host=False)
         self.state, gnorm, overflow = self._jit_apply(self.state, lr)
         if self._offload_plan is not None:
             self._offload_transfer(to_host=True)
+        self.timers(STEP_MICRO_TIMER).stop(
+            sync_obj=self.state["loss_scale"]
+            if self.config.wall_clock_breakdown else None)
+        # Sync only at reporting boundaries: intermediate steps time dispatch
+        # but the window total stays exact, and async overlap is preserved.
+        tput_sync = (self.config.wall_clock_breakdown
+                     or (self.tput_timer.global_step_count + 1)
+                     % self.tput_timer.steps_per_output == 0)
+        self.tput_timer.stop(
+            global_step=True,
+            sync_obj=self.state["loss_scale"] if tput_sync else None)
         self.global_steps += 1
+        self._maybe_profile_flops()
         if self.fp16_enabled:
             # overflow is tiny; fetching it keeps skipped_steps accurate
             if bool(jax.device_get(overflow)):
@@ -570,11 +607,52 @@ class DeepSpeedEngine:
                     ranks=[0])
         if self.lr_scheduler is not None:
             self.lr_scheduler.step(self.global_steps)
-        if self.monitor.enabled and \
-                self.global_steps % self.config.steps_per_print == 0:
-            self.monitor.write_events([
-                ("Train/lr", self.get_lr()[0], self.global_steps)])
+        if self.global_steps % self.config.steps_per_print == 0:
+            if self.config.wall_clock_breakdown:
+                self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER],
+                                memory_breakdown=True)
+            if self.monitor.enabled:
+                self.monitor.write_events([
+                    ("Train/lr", self.get_lr()[0], self.global_steps),
+                    ("Train/samples_per_sec",
+                     self.tput_timer.avg_samples_per_sec(), self.global_steps)])
         return gnorm
+
+    def _maybe_profile_flops(self):
+        """One-shot compiler-derived flops profile at ``profile_step``
+        (reference profiling/flops_profiler wired at engine.py:2182)."""
+        fp = self.config.flops_profiler
+        if (not fp.enabled or self.flops_profiler is not None
+                or self.global_steps < fp.profile_step
+                or self._micro_in_shapes is None):
+            return
+        from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+
+        prof = FlopsProfiler(ds_engine=self,
+                             recompute_fwd_factor=fp.recompute_fwd_factor)
+        prof.start_profile()
+        try:
+            compiled = self._jit_micro.lower(*self._micro_in_shapes).compile()
+            gas = self.config.gradient_accumulation_steps
+            prof.profile_compiled("train_micro(fwd+bwd)", compiled, calls=gas)
+            if self._jit_apply is not None:
+                state_sh = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        x.shape, x.dtype,
+                        sharding=getattr(x, "sharding", None)), self.state)
+                lr_sh = jax.ShapeDtypeStruct(
+                    (), jnp.float32, sharding=NamedSharding(self.mesh, P()))
+                sh = (state_sh, lr_sh)
+                prof.profile_compiled(
+                    "optimizer_step",
+                    self._jit_apply.lower(*sh).compile())
+        except Exception as e:  # pragma: no cover
+            logger.warning(f"flops profile failed: {e}")
+        prof.stop_profile()
+        self.flops_profiler = prof
+        prof.print_model_profile(profile_step=fp.profile_step,
+                                 detailed=fp.detailed,
+                                 output_file=fp.output_file)
 
     def train(self, mode: bool = True):
         self.training = mode

@@ -6,8 +6,15 @@ from deepspeed_tpu.utils.tensors import (
     tree_size_bytes,
     tree_to_flat_dict,
 )
+from deepspeed_tpu.utils.timer import (
+    NoopTimer,
+    SynchronizedWallClockTimer,
+    ThroughputTimer,
+    trim_mean,
+)
 
 __all__ = [
     "logger", "log_dist", "print_rank_0", "tree_to_flat_dict",
     "flat_dict_to_tree", "tree_size_bytes", "tree_num_params", "global_norm",
+    "SynchronizedWallClockTimer", "NoopTimer", "ThroughputTimer", "trim_mean",
 ]

@@ -1,0 +1,247 @@
+"""Wall-clock + throughput timers (reference: utils/timer.py:43
+``SynchronizedWallClockTimer``, :198 ``ThroughputTimer``).
+
+The reference synchronises CUDA events around each region. Under XLA,
+dispatch is asynchronous: a region's host time says nothing unless the
+device work it launched is drained first. Timers here therefore accept an
+optional *sync target* (any jax array / pytree) at ``stop`` time and call
+``jax.block_until_ready`` on it when synchronised timing is requested —
+the TPU analog of ``get_accelerator().synchronize()``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+def _sync(obj: Any) -> None:
+    if obj is None:
+        return
+    try:
+        import jax
+
+        jax.block_until_ready(obj)
+    except Exception:
+        pass
+
+
+def trim_mean(data: List[float], trim_percent: float) -> float:
+    """Mean with symmetric percentile trimming (reference utils/timer.py
+    ``trim_mean``)."""
+    if not data:
+        return 0.0
+    assert 0.0 <= trim_percent <= 1.0
+    n = len(data)
+    data = sorted(data)
+    k = int(round(n * trim_percent))
+    kept = data[k:max(n - k, k + 1)]
+    if not kept:
+        kept = data
+    return sum(kept) / len(kept)
+
+
+class SynchronizedWallClockTimer:
+    """Named timer group (reference utils/timer.py:43)."""
+
+    class Timer:
+        def __init__(self, name: str):
+            self.name_ = name
+            self.started_ = False
+            self.start_time = 0.0
+            self.elapsed_records: List[float] = []
+
+        def start(self) -> None:
+            assert not self.started_, f"{self.name_} timer already started"
+            self.started_ = True
+            self.start_time = time.time()
+
+        def stop(self, reset: bool = False, record: bool = True,
+                 sync_obj: Any = None) -> None:
+            assert self.started_, f"{self.name_} timer is not started"
+            _sync(sync_obj)
+            elapsed = (time.time() - self.start_time) * 1000.0  # msec
+            if reset:
+                self.elapsed_records = [elapsed]
+            elif record:
+                self.elapsed_records.append(elapsed)
+            self.started_ = False
+
+        def reset(self) -> None:
+            self.started_ = False
+            self.elapsed_records = []
+
+        def elapsed(self, reset: bool = True) -> float:
+            """Total recorded msec (optionally resetting the record)."""
+            started = self.started_
+            if started:
+                self.stop(record=True)
+            total = sum(self.elapsed_records)
+            if reset:
+                self.elapsed_records = []
+            if started:
+                self.start()
+            return total
+
+        def mean(self) -> float:
+            if not self.elapsed_records:
+                return 0.0
+            return sum(self.elapsed_records) / len(self.elapsed_records)
+
+    def __init__(self):
+        self.timers: Dict[str, "SynchronizedWallClockTimer.Timer"] = {}
+
+    def __call__(self, name: str) -> "SynchronizedWallClockTimer.Timer":
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    def get_timers(self):
+        return self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        try:
+            from deepspeed_tpu.accelerator import get_accelerator
+
+            stats = get_accelerator().memory_stats()
+            if stats:
+                used = stats.get("bytes_in_use", 0) / (1024 ** 3)
+                peak = stats.get("peak_bytes_in_use", 0) / (1024 ** 3)
+                return f"mem used {used:.2f} GB | peak {peak:.2f} GB"
+        except Exception:
+            pass
+        return "mem stats unavailable"
+
+    def log(self, names: List[str], normalizer: float = 1.0,
+            reset: bool = True, memory_breakdown: bool = False,
+            ranks: Optional[List[int]] = None) -> Dict[str, float]:
+        """Log (and return) msec/normalizer for each named timer."""
+        assert normalizer > 0.0
+        means: Dict[str, float] = {}
+        for name in names:
+            if name in self.timers:
+                means[name] = self.timers[name].elapsed(reset=reset) / normalizer
+        string = "time (ms) | " + " | ".join(
+            f"{k}: {v:.2f}" for k, v in means.items())
+        if memory_breakdown:
+            string += " | " + self.memory_usage()
+        log_dist(string, ranks=ranks or [0])
+        return means
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0,
+                 reset: bool = True) -> Dict[str, float]:
+        assert normalizer > 0.0
+        means = {}
+        for name in names:
+            if name in self.timers and self.timers[name].elapsed_records:
+                means[name] = self.timers[name].mean() / normalizer
+                if reset:
+                    self.timers[name].reset()
+        return means
+
+
+class NoopTimer:
+    """Disabled timers (reference utils/timer.py:163)."""
+
+    class Timer:
+        def start(self):
+            ...
+
+        def reset(self):
+            ...
+
+        def stop(self, **kwargs):
+            ...
+
+        def elapsed(self, **kwargs):
+            return 0.0
+
+        def mean(self):
+            return 0.0
+
+    def __init__(self):
+        self.timer = self.Timer()
+
+    def __call__(self, name: str):
+        return self.timer
+
+    def get_timers(self):
+        return {}
+
+    def log(self, names=None, normalizer=1.0, reset=True,
+            memory_breakdown=False, ranks=None):
+        return {}
+
+    def get_mean(self, names=None, normalizer=1.0, reset=True):
+        return {}
+
+
+class ThroughputTimer:
+    """Samples/sec over optimizer steps (reference utils/timer.py:198).
+
+    ``batch_size`` is the *global* train batch per step. The first
+    ``start_step`` steps are excluded from the average (compile warm-up —
+    the reference excludes them as cudnn autotune noise; on TPU they are
+    XLA compilations).
+    """
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: int = 50, monitor_memory: bool = False,
+                 logging_fn=None):
+        self.batch_size = max(1, int(batch_size))
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
+        self.initialized = False
+        self.global_step_count = 0
+        self.local_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.start_time = 0.0
+        self.started = False
+
+    def update_epoch_count(self):
+        self.local_step_count = 0
+
+    def start(self):
+        self.started = True
+        self.start_time = time.time()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True,
+             sync_obj: Any = None):
+        if not self.started:
+            return
+        self.started = False
+        _sync(sync_obj)
+        duration = time.time() - self.start_time
+        if global_step:
+            self.global_step_count += 1
+            self.local_step_count += 1
+            if self.global_step_count > self.start_step:
+                self.total_elapsed_time += duration
+                self.step_elapsed_time += duration
+                if report_speed and \
+                        self.global_step_count % self.steps_per_output == 0:
+                    self.logging(
+                        f"epoch step {self.local_step_count}/"
+                        f"global {self.global_step_count}: "
+                        f"{self.avg_samples_per_sec():.2f} samples/sec, "
+                        f"batch {self.batch_size}")
+                    self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        counted = self.global_step_count - self.start_step
+        if counted > 0 and self.total_elapsed_time > 0:
+            return counted * self.batch_size / self.total_elapsed_time
+        return 0.0
