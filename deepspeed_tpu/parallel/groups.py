@@ -28,9 +28,15 @@ def initialize_mesh(
     sequence_parallel_size: int = 1,
     expert_parallel_size: int = 1,
     model_parallel_size: int = 1,
+    zero_subgroup_size: int = 0,
     devices=None,
 ) -> MeshTopology:
-    """Build (or rebuild) the global mesh topology."""
+    """Build (or rebuild) the global mesh topology.
+
+    ``zero_subgroup_size`` > 0 splits the data axis into
+    ``dout × zero_subgroup_size`` — the ZeRO++ hpZ secondary partition /
+    MiCS sharding sub-group (reference utils/groups.py:505, zero/mics.py).
+    """
     global _topology
     dims = ParallelDims(
         pipe=pipe_parallel_size,
@@ -39,6 +45,11 @@ def initialize_mesh(
         expert=expert_parallel_size,
         model=model_parallel_size,
     )
+    if zero_subgroup_size and zero_subgroup_size > 0:
+        import jax
+
+        n = len(devices) if devices is not None else len(jax.devices())
+        dims = dims.resolve(n).split_data_axis(zero_subgroup_size)
     _topology = MeshTopology(dims, devices=devices)
     return _topology
 

@@ -11,24 +11,31 @@ ICI (intra-slice) or DCN (cross-slice) from the mesh's device assignment.
 
 Canonical axis order (outer → inner):
 
-    ('pipe', 'data', 'seq', 'expert', 'model')
+    ('pipe', 'dout', 'data', 'seq', 'expert', 'model')
 
 * ``pipe``   — pipeline stages (reference PipelineParallelGrid pipe axis)
-* ``data``   — pure data parallel replicas
+* ``dout``   — data-parallel *outer* replicas (size 1 unless ZeRO++ hpZ /
+  MiCS splits the data axis: ``dout × data`` spans the dp replicas, with
+  ``data`` the intra-node/ICI sub-group — the reference's secondary
+  partition group ``utils/groups.py:505 _create_zero_param_parallel_group``
+  and MiCS sharding sub-group ``zero/mics.py``)
+* ``data``   — data parallel replicas (the hpZ/MiCS sub-group when dout>1)
 * ``seq``    — Ulysses sequence parallel (reference sequence_parallel group)
 * ``expert`` — expert parallel (reference expert_parallel group)
 * ``model``  — tensor parallel (reference model_parallel group)
 
 Derived groups (tuples of axes):
 
-* batch (data-loader) axes: ``('data', 'expert')`` — each dp replica sees a
-  distinct micro-batch slice; seq ranks share the batch but split the
+* batch (data-loader) axes: ``('dout', 'data', 'expert')`` — each dp replica
+  sees a distinct micro-batch slice; seq ranks share the batch but split the
   sequence dim.
-* ZeRO / dense-grad axes: ``('data', 'seq', 'expert')`` — matches the
-  reference's use of the *seq_data_parallel* group as the ZeRO partition
+* ZeRO / dense-grad axes: ``('dout', 'data', 'seq', 'expert')`` — matches
+  the reference's use of the *seq_data_parallel* group as the ZeRO partition
   group (``runtime/engine.py:1125,1509``).
-* expert-data axes: ``('data', 'seq')`` — grad reduction group for expert
-  params (reference ``_reduce_expert_gradients``, engine.py:2406).
+* ZeRO secondary (hpZ/MiCS) axes: ``('data', 'seq', 'expert')`` — the inner
+  sub-group when ``dout`` > 1.
+* expert-data axes: ``('dout', 'data', 'seq')`` — grad reduction group for
+  expert params (reference ``_reduce_expert_gradients``, engine.py:2406).
 
 ``model`` is innermost so TP collectives ride the fastest ICI links; ``pipe``
 is outermost so stage p2p transfers cross the slowest links, mirroring the
@@ -43,16 +50,21 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-MESH_AXES: Tuple[str, ...] = ("pipe", "data", "seq", "expert", "model")
+MESH_AXES: Tuple[str, ...] = ("pipe", "dout", "data", "seq", "expert", "model")
 
 # Axis-group aliases accepted anywhere a "group" is taken (comm facade, ZeRO).
 GROUP_ALIASES: Dict[str, Tuple[str, ...]] = {
     "world": MESH_AXES,
-    "data_parallel": ("data", "expert"),
-    "dp": ("data", "expert"),
-    "seq_data_parallel": ("data", "seq", "expert"),
-    "sdp": ("data", "seq", "expert"),
-    "zero": ("data", "seq", "expert"),
+    "data_parallel": ("dout", "data", "expert"),
+    "dp": ("dout", "data", "expert"),
+    "seq_data_parallel": ("dout", "data", "seq", "expert"),
+    "sdp": ("dout", "data", "seq", "expert"),
+    "zero": ("dout", "data", "seq", "expert"),
+    # hpZ/MiCS secondary partition: the intra-node sub-group of the zero
+    # group (reference _create_zero_param_parallel_group, zero/mics.py)
+    "zero_secondary": ("data", "seq", "expert"),
+    "hpz": ("data", "seq", "expert"),
+    "zero_outer": ("dout",),
     "sequence_parallel": ("seq",),
     "sp": ("seq",),
     "model_parallel": ("model",),
@@ -61,8 +73,8 @@ GROUP_ALIASES: Dict[str, Tuple[str, ...]] = {
     "mp": ("model",),
     "expert_parallel": ("expert",),
     "ep": ("expert",),
-    "expert_data_parallel": ("data", "seq"),
-    "edp": ("data", "seq"),
+    "expert_data_parallel": ("dout", "data", "seq"),
+    "edp": ("dout", "data", "seq"),
     "pipe_parallel": ("pipe",),
     "pp": ("pipe",),
 }
@@ -70,34 +82,52 @@ GROUP_ALIASES: Dict[str, Tuple[str, ...]] = {
 
 @dataclasses.dataclass(frozen=True)
 class ParallelDims:
-    """Degrees of each parallelism flavour. ``data=-1`` infers from devices."""
+    """Degrees of each parallelism flavour. ``data=-1`` infers from devices.
+
+    ``dout`` (data-outer) defaults to 1; hpZ/MiCS split the dp replicas as
+    ``dout × data`` (see :func:`split_data_axis`).
+    """
 
     pipe: int = 1
+    dout: int = 1
     data: int = -1
     seq: int = 1
     expert: int = 1
     model: int = 1
 
     def resolve(self, n_devices: int) -> "ParallelDims":
-        fixed = self.pipe * self.seq * self.expert * self.model
+        fixed = self.pipe * self.dout * self.seq * self.expert * self.model
         data = self.data
         if data == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
                     f"device count {n_devices} not divisible by "
-                    f"pipe*seq*expert*model={fixed}")
+                    f"pipe*dout*seq*expert*model={fixed}")
             data = n_devices // fixed
-        if self.pipe * data * self.seq * self.expert * self.model != n_devices:
+        if self.pipe * self.dout * data * self.seq * self.expert * \
+                self.model != n_devices:
             raise ValueError(
                 f"mesh {self.as_dict()} (data={data}) does not cover "
                 f"{n_devices} devices")
         return dataclasses.replace(self, data=data)
 
+    def split_data_axis(self, inner_size: int) -> "ParallelDims":
+        """Split the (resolved) data axis into ``dout × inner_size`` for the
+        hpZ/MiCS secondary partition."""
+        total = self.dout * self.data
+        if inner_size <= 0 or total % inner_size != 0:
+            raise ValueError(
+                f"secondary partition size {inner_size} does not divide the "
+                f"data-parallel degree {total}")
+        return dataclasses.replace(self, dout=total // inner_size,
+                                   data=inner_size)
+
     def as_dict(self) -> Dict[str, int]:
         return dataclasses.asdict(self)
 
     def shape(self) -> Tuple[int, ...]:
-        return (self.pipe, self.data, self.seq, self.expert, self.model)
+        return (self.pipe, self.dout, self.data, self.seq, self.expert,
+                self.model)
 
 
 class MeshTopology:

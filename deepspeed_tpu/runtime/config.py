@@ -96,7 +96,7 @@ class ZeroConfig(DeepSpeedConfigModel):
     """reference: zero/config.py DeepSpeedZeroConfig.
 
     TPU mapping: stages are sharding policies over the ZeRO mesh axes
-    ('data','seq','expert') —
+    ('dout','data','seq','expert') —
       0: params/grads/optim replicated;
       1: optimizer state (incl. fp32 master) sharded;
       2: + gradients reduce-scattered and kept sharded;

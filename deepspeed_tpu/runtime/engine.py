@@ -57,7 +57,7 @@ from deepspeed_tpu.utils.timer import (FORWARD_MICRO_TIMER, STEP_MICRO_TIMER,
                                        NoopTimer, SynchronizedWallClockTimer,
                                        ThroughputTimer)
 
-BATCH_AXES = GROUP_ALIASES["dp"]  # ('data','expert')
+BATCH_AXES = GROUP_ALIASES["dp"]  # ('dout','data','expert')
 
 
 def _as_model_fns(model, loss_fn) -> Tuple[Callable, Callable]:
@@ -159,10 +159,48 @@ class DeepSpeedEngine:
 
         # zero shardings ----------------------------------------------------
         self.zero_stage = self.config.zero_optimization_stage
+        zc0 = self.config.zero_config
+        # ZeRO++ hpZ / MiCS: secondary partition = the inner ('data',...) zero
+        # sub-group; the mesh must have been built with the data axis split
+        # (groups.initialize_mesh(zero_subgroup_size=k) → dout×k replicas).
+        self._hpz_size = int(zc0.zero_hpz_partition_size or 1)
+        self._mics_size = int(zc0.mics_shard_size or -1)
+        param_axes = master_axes = grad_axes = None
+        secondary = self._mics_size if self._mics_size > 0 else \
+            (self._hpz_size if self._hpz_size > 1 else 0)
+        if secondary:
+            inner = self.topology.axis_size("zero_secondary")
+            if inner != secondary:
+                # inner group = data × seq × expert, so the data-axis split
+                # that realises a secondary partition of `secondary` is
+                # secondary / (seq*expert).
+                se = self.topology.get_dim("seq") * \
+                    self.topology.get_dim("expert")
+                if secondary % se != 0:
+                    raise ValueError(
+                        f"hpZ/MiCS secondary partition size {secondary} must "
+                        f"be a multiple of seq*expert parallel degree {se} "
+                        f"(the inner zero group spans ('data','seq',"
+                        f"'expert'))")
+                raise ValueError(
+                    f"hpZ/MiCS secondary partition size {secondary} requires "
+                    f"the mesh's inner zero group ('data','seq','expert') to "
+                    f"have that size (got {inner}); build the mesh with "
+                    f"groups.initialize_mesh(zero_subgroup_size="
+                    f"{secondary // se}, ...)")
+            param_axes = GROUP_ALIASES["zero_secondary"]
+            if self._mics_size > 0:
+                # MiCS: *all* state confined to the sub-group (zero/mics.py);
+                # gradient reduction still spans all replicas (hierarchical
+                # allreduce = XLA reduce-scatter(inner) + all-reduce(dout)).
+                master_axes = param_axes
+                grad_axes = param_axes
         self.zero = ZeroShardings(
             self.zero_stage, self.topology,
-            param_persistence_threshold=self.config.zero_config.param_persistence_threshold
-            if self.zero_stage >= 3 else 0)
+            param_persistence_threshold=zc0.param_persistence_threshold
+            if self.zero_stage >= 3 else 0,
+            param_axes=param_axes, master_axes=master_axes,
+            grad_axes=grad_axes)
 
         # offload (reference zero/parameter_offload.py; OffloadPP ratio) ----
         from deepspeed_tpu.runtime.zero.offload import validate_offload_config
@@ -224,6 +262,8 @@ class DeepSpeedEngine:
         self._jit_micro: Optional[Callable] = None
         self._jit_apply: Optional[Callable] = None
         self._jit_eval: Optional[Callable] = None
+        self._micro_compiled = None  # AOT executables (flops profiler path)
+        self._apply_compiled = None
         self._shardings: Optional[Dict[str, Any]] = None
         self._rng = jax.random.key(self.config.seed)
 
@@ -400,6 +440,18 @@ class DeepSpeedEngine:
         """The micro program reads ONLY (params, acc_grads, loss_scale) —
         master weights and optimizer moments never flow through it, so with
         offload enabled they stay host-resident across micro-steps."""
+        zc = self.config.zero_config
+        if (zc.zero_quantized_weights and self.zero_stage >= 3) or \
+                zc.zero_quantized_gradients:
+            from deepspeed_tpu.runtime.zero.zeropp import build_quantized_micro
+
+            log_dist(
+                "ZeRO++: quantized "
+                f"{'weight all-gather ' if zc.zero_quantized_weights else ''}"
+                f"{'gradient reduce-scatter' if zc.zero_quantized_gradients else ''}"
+                " (int8 wire format)", ranks=[0])
+            self._jit_micro = build_quantized_micro(self)
+            return
         gas = self._grad_accum_divisor()
         sh = self._state_shardings()
 
@@ -527,7 +579,16 @@ class DeepSpeedEngine:
                 lambda x: jax.ShapeDtypeStruct(
                     x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
                 inputs)
-        self.state["acc_grads"], loss = self._jit_micro(*inputs)
+        micro_fn = self._jit_micro
+        if self.config.flops_profiler.enabled:
+            # AOT-compile once and reuse the executable for both execution
+            # and the profiler's cost_analysis — no duplicate compile at
+            # profile_step.
+            if self._micro_compiled is None:
+                self._micro_compiled = self._jit_micro.lower(
+                    *self._micro_in_shapes).compile()
+            micro_fn = self._micro_compiled
+        self.state["acc_grads"], loss = micro_fn(*inputs)
         self.timers(FORWARD_MICRO_TIMER).stop(
             sync_obj=loss if self.config.wall_clock_breakdown else None)
         self._last_loss = loss
@@ -581,7 +642,19 @@ class DeepSpeedEngine:
         self.timers(STEP_MICRO_TIMER).start()
         if self._offload_plan is not None:
             self._offload_transfer(to_host=False)
-        self.state, gnorm, overflow = self._jit_apply(self.state, lr)
+        apply_fn = self._jit_apply
+        if self.config.flops_profiler.enabled:
+            if self._apply_compiled is None:
+                state_sh = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        x.shape, x.dtype,
+                        sharding=getattr(x, "sharding", None)), self.state)
+                lr_sh = jax.ShapeDtypeStruct(
+                    (), jnp.float32, sharding=NamedSharding(self.mesh, P()))
+                self._apply_compiled = self._jit_apply.lower(
+                    state_sh, lr_sh).compile()
+            apply_fn = self._apply_compiled
+        self.state, gnorm, overflow = apply_fn(self.state, lr)
         if self._offload_plan is not None:
             self._offload_transfer(to_host=True)
         self.timers(STEP_MICRO_TIMER).stop(
@@ -632,20 +705,14 @@ class DeepSpeedEngine:
                              recompute_fwd_factor=fp.recompute_fwd_factor)
         prof.start_profile()
         try:
-            compiled = self._jit_micro.lower(*self._micro_in_shapes).compile()
+            # Reuse the AOT executables forward()/step() already compiled —
+            # the profile itself costs no extra compilation.
             gas = self.config.gradient_accumulation_steps
-            prof.profile_compiled("train_micro(fwd+bwd)", compiled, calls=gas)
-            if self._jit_apply is not None:
-                state_sh = jax.tree.map(
-                    lambda x: jax.ShapeDtypeStruct(
-                        x.shape, x.dtype,
-                        sharding=getattr(x, "sharding", None)), self.state)
-                lr_sh = jax.ShapeDtypeStruct(
-                    (), jnp.float32, sharding=NamedSharding(self.mesh, P()))
-                sh = (state_sh, lr_sh)
-                prof.profile_compiled(
-                    "optimizer_step",
-                    self._jit_apply.lower(*sh).compile())
+            if self._micro_compiled is not None:
+                prof.profile_compiled("train_micro(fwd+bwd)",
+                                      self._micro_compiled, calls=gas)
+            if self._apply_compiled is not None:
+                prof.profile_compiled("optimizer_step", self._apply_compiled)
         except Exception as e:  # pragma: no cover
             logger.warning(f"flops profile failed: {e}")
         prof.stop_profile()

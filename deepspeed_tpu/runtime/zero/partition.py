@@ -4,7 +4,7 @@ stage3.py:72, partition_parameters.py:734).
 The reference implements ZeRO with per-parameter flattening, bucketing,
 gradient hooks, and prefetch machinery because torch has no compiler-visible
 sharding. On TPU the same *capability* is a set of ``PartitionSpec`` policies
-over the ZeRO mesh axes ``('data','seq','expert')``:
+over the ZeRO mesh axes ``('dout','data','seq','expert')``:
 
 =====  ===================  ===================  =====================
 stage  optimizer state      gradients            parameters
@@ -37,7 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel.topology import GROUP_ALIASES, MeshTopology
 
-ZERO_AXES: Tuple[str, ...] = GROUP_ALIASES["zero"]  # ('data','seq','expert')
+ZERO_AXES: Tuple[str, ...] = GROUP_ALIASES["zero"]  # ('dout','data','seq','expert')
 
 
 def _axis_sizes(topology: MeshTopology, axes: Tuple[str, ...]) -> int:
@@ -100,23 +100,37 @@ def _map_specs(tree_shapes, base_specs, fn: Callable) -> Any:
 
 
 class ZeroShardings:
-    """Per-stage sharding policy for every component of train state."""
+    """Per-stage sharding policy for every component of train state.
+
+    ``param_axes`` / ``master_axes`` / ``grad_axes`` override the zero group
+    per component — the ZeRO++ hpZ secondary partition shards *params* over
+    the intra-node sub-group only (reference utils/groups.py:505), and MiCS
+    confines *all* state to the sub-group (zero/mics.py), replicating over
+    the outer ``dout`` axis.
+    """
 
     def __init__(self, stage: int, topology: MeshTopology,
                  param_persistence_threshold: int = 0,
-                 zero_axes: Tuple[str, ...] = ZERO_AXES):
+                 zero_axes: Tuple[str, ...] = ZERO_AXES,
+                 param_axes: Optional[Tuple[str, ...]] = None,
+                 master_axes: Optional[Tuple[str, ...]] = None,
+                 grad_axes: Optional[Tuple[str, ...]] = None):
         self.stage = stage
         self.topology = topology
         self.zero_axes = zero_axes
+        self.param_axes = param_axes if param_axes is not None else zero_axes
+        self.master_axes = master_axes if master_axes is not None else zero_axes
+        self.grad_axes = grad_axes if grad_axes is not None else zero_axes
         self.persistence_threshold = param_persistence_threshold
 
-    def _sharded(self, shapes, base_specs, min_size=None):
+    def _sharded(self, shapes, base_specs, min_size=None, axes=None):
         min_size = self.persistence_threshold if min_size is None else min_size
+        axes = self.zero_axes if axes is None else axes
 
         def fn(shape_leaf, base):
             shape = tuple(shape_leaf.shape) if hasattr(shape_leaf, "shape") \
                 else tuple(shape_leaf)
-            return shard_leaf_spec(shape, base, self.topology, self.zero_axes,
+            return shard_leaf_spec(shape, base, self.topology, axes,
                                    min_size=min_size)
 
         return _map_specs(shapes, base_specs, fn)
@@ -131,20 +145,22 @@ class ZeroShardings:
     def param_specs(self, shapes, base_specs=None):
         """Compute-precision parameters (the model's working copy)."""
         if self.stage >= 3:
-            return self._sharded(shapes, base_specs)
+            return self._sharded(shapes, base_specs, axes=self.param_axes)
         return self._base(shapes, base_specs)
 
     def master_specs(self, shapes, base_specs=None):
         """fp32 master weights + optimizer moments (no persistence floor —
         the reference shards *all* optimizer state from stage 1)."""
         if self.stage >= 1:
-            return self._sharded(shapes, base_specs, min_size=0)
+            return self._sharded(shapes, base_specs, min_size=0,
+                                 axes=self.master_axes)
         return self._base(shapes, base_specs)
 
     def grad_specs(self, shapes, base_specs=None):
         """Accumulated gradients: sharded (reduce-scatter) from stage 2."""
         if self.stage >= 2:
-            return self._sharded(shapes, base_specs, min_size=0)
+            return self._sharded(shapes, base_specs, min_size=0,
+                                 axes=self.grad_axes)
         return self._base(shapes, base_specs)
 
     def to_named(self, spec_tree):

@@ -207,7 +207,8 @@ class ThroughputTimer:
         self.global_step_count = 0
         self.local_step_count = 0
         self.total_elapsed_time = 0.0
-        self.step_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0   # window since last report
+        self.window_step_count = 0
         self.start_time = 0.0
         self.started = False
 
@@ -231,17 +232,30 @@ class ThroughputTimer:
             if self.global_step_count > self.start_step:
                 self.total_elapsed_time += duration
                 self.step_elapsed_time += duration
+                self.window_step_count += 1
                 if report_speed and \
                         self.global_step_count % self.steps_per_output == 0:
                     self.logging(
                         f"epoch step {self.local_step_count}/"
                         f"global {self.global_step_count}: "
-                        f"{self.avg_samples_per_sec():.2f} samples/sec, "
-                        f"batch {self.batch_size}")
+                        f"{self.avg_samples_per_sec():.2f} avg samples/sec, "
+                        f"{self.curr_samples_per_sec():.2f} curr samples/sec,"
+                        f" batch {self.batch_size}")
                     self.step_elapsed_time = 0.0
+                    self.window_step_count = 0
 
     def avg_samples_per_sec(self) -> float:
+        """Lifetime average (since ``start_step``)."""
         counted = self.global_step_count - self.start_step
         if counted > 0 and self.total_elapsed_time > 0:
             return counted * self.batch_size / self.total_elapsed_time
         return 0.0
+
+    def curr_samples_per_sec(self) -> float:
+        """Recent-window rate (the reference ThroughputTimer's
+        CurrSamplesPerSec, utils/timer.py:309): steps since the last
+        periodic report."""
+        if self.window_step_count > 0 and self.step_elapsed_time > 0:
+            return self.window_step_count * self.batch_size / \
+                self.step_elapsed_time
+        return self.avg_samples_per_sec()

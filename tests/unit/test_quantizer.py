@@ -1,0 +1,94 @@
+"""Quantization kernel numerics (reference tests/unit/ops/quantizer pattern:
+kernel vs eager composition with dtype tolerances)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import quantizer as Q
+
+
+def _rand(shape, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+@pytest.mark.parametrize("num_bits", [8, 4])
+@pytest.mark.parametrize("symmetric", [True, False])
+def test_quantize_roundtrip(num_bits, symmetric):
+    x = _rand((16, 256))
+    groups = 16
+    q, s, o = Q.quantize(x, groups, num_bits, symmetric)
+    out = Q.dequantize(q, s, o, num_bits).reshape(x.shape)
+    # max error bounded by half a quantization step per group
+    g = x.reshape(groups, -1)
+    if symmetric:
+        step = np.abs(g).max(axis=1) / (2 ** (num_bits - 1) - 1)
+    else:
+        step = (g.max(axis=1) - g.min(axis=1)) / (2 ** num_bits - 1)
+    err = np.abs(np.asarray(out - x)).reshape(groups, -1).max(axis=1)
+    assert (err <= step * 0.501 + 1e-7).all()
+
+
+def test_fake_quantize_preserves_shape_dtype():
+    x = _rand((4, 8, 32)).astype(jnp.bfloat16)
+    y = Q.fake_quantize(x, 4, 8)
+    assert y.shape == x.shape and y.dtype == x.dtype
+    assert float(jnp.abs(y.astype(jnp.float32) - x.astype(jnp.float32)).mean()) < 0.05
+
+
+def test_stochastic_quantize_unbiased():
+    x = jnp.full((1, 4096), 0.3)  # 0.3 not representable on the int8 grid
+    keys = jax.random.split(jax.random.PRNGKey(1), 64)
+    outs = []
+    for k in keys:
+        q, s, o = Q.stochastic_quantize(x, 1, k)
+        outs.append(np.asarray(Q.dequantize(q, s, o)).mean())
+    # mean over many SR draws converges to the true value
+    assert abs(np.mean(outs) - 0.3) < 1e-3
+
+
+def test_quantized_reduce_matches_mean():
+    ranks, groups, gs = 4, 8, 128
+    x = _rand((ranks, groups * gs))
+    qs, ss = [], []
+    for r in range(ranks):
+        q, s, _ = Q.quantize(x[r], groups, 8, True)
+        qs.append(q)
+        ss.append(s)
+    q_out, s_out = Q.quantized_reduce(jnp.stack(qs), jnp.stack(ss), ranks)
+    got = Q.dequantize(q_out, s_out).reshape(-1)
+    want = np.asarray(x).mean(axis=0)
+    assert np.abs(np.asarray(got) - want).max() < 0.02
+
+
+def test_int4_pack_roundtrip():
+    x = _rand((8, 64))
+    q, s, _ = Q.quantize(x, 8, 4, True)
+    packed = Q.pack_int4(q)
+    assert packed.shape == (8, 32)
+    unpacked = Q.unpack_int4(packed)
+    assert (np.asarray(unpacked) == np.asarray(q)).all()
+
+
+def test_swizzle_unswizzle_roundtrip():
+    x = _rand((4, 256))
+    q, s = Q.swizzle_quant(x, 4, pipeline_size=4)
+    deq = Q.dequantize(q, s).reshape(-1)
+    restored = Q.unswizzle(deq, 4).reshape(x.shape)
+    step = np.abs(np.asarray(x)).max() / 127
+    assert np.abs(np.asarray(restored) - np.asarray(x)).max() <= step + 1e-6
+
+
+def test_quantize_pallas_matches_jnp():
+    x = _rand((8, 512))
+    q_ref, s_ref, _ = Q.quantize(x, 8, 8, True)
+    q_k, s_k = Q.quantize_pallas(x, 8)
+    assert (np.asarray(q_k) == np.asarray(q_ref)).all()
+    np.testing.assert_allclose(np.asarray(s_k), np.asarray(s_ref), rtol=1e-6)
+
+
+def test_op_builder_entry():
+    from deepspeed_tpu.ops.op_builder import get_op_builder
+    mod = get_op_builder("quantizer").load()
+    assert hasattr(mod, "quantize")

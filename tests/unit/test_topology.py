@@ -20,7 +20,7 @@ def test_resolve_data_axis():
 
 def test_mixed_dims():
     topo = MeshTopology(ParallelDims(data=2, model=2, pipe=2))
-    assert topo.dims.shape() == (2, 2, 1, 1, 2)
+    assert topo.dims.shape() == (2, 1, 2, 1, 1, 2)
     assert topo.model_parallel_size == 2
     assert topo.pipe_parallel_size == 2
     assert topo.zero_partition_size == 2
@@ -42,7 +42,7 @@ def test_coords_roundtrip():
 
 def test_filter_match():
     topo = MeshTopology(ParallelDims(data=4, model=2))
-    tp_group = topo.filter_match(pipe=0, data=0, seq=0, expert=0)
+    tp_group = topo.filter_match(pipe=0, dout=0, data=0, seq=0, expert=0)
     assert len(tp_group) == 2  # the two model-parallel ranks
 
 
@@ -55,10 +55,10 @@ def test_axis_comm_lists():
 
 
 def test_group_aliases():
-    assert resolve_group("dp") == ("data", "expert")
-    assert resolve_group("sdp") == ("data", "seq", "expert")
+    assert resolve_group("dp") == ("dout", "data", "expert")
+    assert resolve_group("sdp") == ("dout", "data", "seq", "expert")
     assert resolve_group("tp") == ("model",)
-    assert resolve_group(None) == ("data", "seq", "expert")
+    assert resolve_group(None) == ("dout", "data", "seq", "expert")
     assert resolve_group(("data",)) == ("data",)
     with pytest.raises(ValueError):
         resolve_group("nonsense")

@@ -16,21 +16,21 @@ def _topo(**kw):
 def test_shard_leaf_picks_divisible_dim():
     topo = _topo(data=8)
     spec = shard_leaf_spec((16, 3), None, topo)
-    assert spec == P(("data", "seq", "expert"), None)
+    assert spec == P(("dout", "data", "seq", "expert"), None)
 
 
 def test_shard_leaf_respects_base_tp():
     topo = _topo(data=4, model=2)
     # dim0 sharded by TP already; ZeRO goes to dim1
     spec = shard_leaf_spec((8, 8), P("model", None), topo)
-    assert spec == P("model", ("data", "seq", "expert"))
+    assert spec == P("model", ("dout", "data", "seq", "expert"))
 
 
 def test_shard_leaf_combines_on_same_dim():
     topo = _topo(data=4, model=2)
     # dim1 too small; dim0 already sharded by model but 16/2=8 divisible by 4
     spec = shard_leaf_spec((16, 3), P("model", None), topo)
-    assert spec == P(("model", "data", "seq", "expert"), None)
+    assert spec == P(("model", "dout", "data", "seq", "expert"), None)
 
 
 def test_small_param_stays_replicated():
