@@ -1,0 +1,17 @@
+"""Elastic training (reference: deepspeed/elasticity/)."""
+
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+)
+
+__all__ = [
+    "ElasticityConfig", "ElasticityConfigError", "ElasticityError",
+    "ElasticityIncompatibleWorldSize", "compute_elastic_config",
+    "elasticity_enabled", "ensure_immutable_elastic_config",
+]

@@ -1,0 +1,267 @@
+"""``deepspeed`` CLI runner (reference: launcher/runner.py:388 ``main`` —
+hostfile parsing :200, include/exclude filters :255-351, world-info
+encoding :353).
+
+TPU-native process model: the reference spawns one process **per GPU**; a
+JAX TPU host runs ONE process controlling all local chips, with
+``jax.distributed.initialize`` as the rendezvous (the NCCL/MPI analogue).
+So the runner resolves the host pool, then
+
+* single host → exec :mod:`deepspeed_tpu.launcher.launch` locally;
+* multi host  → one ssh/pdsh command per host running ``launch`` with
+  ``COORDINATOR_ADDRESS`` (coordinator host:port), ``NNODES``/``NODE_RANK``
+  exported — launch then derives WORLD_SIZE/RANK for its children.
+
+Command construction is separated from execution so the multinode path is
+testable without ssh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ("PYTHONPATH", "XLA_FLAGS", "JAX_PLATFORMS", "TPU_CHIPS_PER_HOST",
+               "DS_ACCELERATOR")
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile: lines of '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="subset hosts/slots: 'h1@h2:0,2' syntax")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="exclude hosts/slots, same syntax as --include")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="cap on number of hosts to use")
+    parser.add_argument("--num_gpus", "--num_accelerators", dest="num_gpus",
+                        type=int, default=-1,
+                        help="processes per host (reference --num_gpus; on "
+                        "TPU usually 1 process drives all local chips)")
+    parser.add_argument("--master_port", type=int,
+                        default=int(os.environ.get("DS_MASTER_PORT", 29500)))
+    parser.add_argument("--master_addr", type=str,
+                        default=os.environ.get("DS_MASTER_ADDR", ""))
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=("ssh", "pdsh", "local"))
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--module", action="store_true",
+                        help="run user_script as 'python -m <module>'")
+    parser.add_argument("--no_python", action="store_true",
+                        help="exec user_script directly, no interpreter")
+    parser.add_argument("--no_ssh_check", action="store_true")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--elastic_training", action="store_true")
+    parser.add_argument("--min_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--max_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--save_pid", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+# ------------------------------------------------------------------ #
+# Host pool resolution
+# ------------------------------------------------------------------ #
+def fetch_hostfile(path: str) -> Optional[Dict[str, int]]:
+    """'<host> slots=<n>' per line → ordered {host: slots}. Comments (#)
+    and blank lines ignored; malformed lines raise."""
+    if not os.path.isfile(path):
+        logger.warning(f"hostfile {path} not found")
+        return None
+    pool: "OrderedDict[str, int]" = OrderedDict()
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) == 1:
+                host, slots = parts[0], 1
+            elif len(parts) == 2 and parts[1].startswith("slots="):
+                host, slots = parts[0], int(parts[1][len("slots="):])
+            else:
+                raise ValueError(f"malformed hostfile line: {line!r}")
+            if host in pool:
+                raise ValueError(f"duplicate host {host} in hostfile")
+            pool[host] = slots
+    return pool
+
+
+def _parse_filter(spec: str) -> Dict[str, Optional[List[int]]]:
+    """'h1@h2:0,2' → {h1: None (all slots), h2: [0, 2]}."""
+    out: Dict[str, Optional[List[int]]] = OrderedDict()
+    if not spec:
+        return out
+    for part in spec.split("@"):
+        if ":" in part:
+            host, slots = part.split(":", 1)
+            out[host] = sorted({int(s) for s in slots.split(",")})
+        else:
+            out[part] = None
+    return out
+
+
+def parse_inclusion_exclusion(resource_pool: Dict[str, int],
+                              inclusion: str, exclusion: str,
+                              ) -> Dict[str, List[int]]:
+    """Apply --include/--exclude to the hostfile pool (reference
+    parse_resource_filter:255). Returns {host: [slot ids]}."""
+    pool: "OrderedDict[str, List[int]]" = OrderedDict(
+        (h, list(range(n))) for h, n in resource_pool.items())
+    inc, exc = _parse_filter(inclusion), _parse_filter(exclusion)
+    if inc and exc:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    for host in list(inc) + list(exc):
+        if host not in pool:
+            raise ValueError(f"filtered host {host} not in hostfile")
+    if inc:
+        picked = OrderedDict()
+        for host, slots in inc.items():
+            avail = pool[host]
+            use = avail if slots is None else slots
+            bad = set(use) - set(avail)
+            if bad:
+                raise ValueError(f"host {host} has no slots {sorted(bad)}")
+            picked[host] = sorted(use)
+        return picked
+    for host, slots in exc.items():
+        if slots is None:
+            del pool[host]
+        else:
+            pool[host] = [s for s in pool[host] if s not in slots]
+            if not pool[host]:
+                del pool[host]
+    return pool
+
+
+def encode_world_info(world_info: Dict[str, List[int]]) -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(world_info).encode()).decode()
+
+
+def decode_world_info(encoded: str) -> Dict[str, List[int]]:
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+# ------------------------------------------------------------------ #
+# Command construction
+# ------------------------------------------------------------------ #
+def _user_cmd(args) -> List[str]:
+    cmd: List[str] = []
+    if not args.no_python:
+        cmd += [sys.executable, "-u"]
+        if args.module:
+            cmd += ["-m"]
+    cmd.append(args.user_script)
+    cmd += args.user_args
+    return cmd
+
+
+def build_launch_cmd(args, world_info: Dict[str, List[int]],
+                     node_rank: int, master_addr: str) -> List[str]:
+    """The per-host ``launch`` invocation."""
+    return [
+        sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+        f"--world_info={encode_world_info(world_info)}",
+        f"--node_rank={node_rank}",
+        f"--master_addr={master_addr}",
+        f"--master_port={args.master_port}",
+    ] + (["--save_pid"] if args.save_pid else []) + \
+        (["--no_python"] if args.no_python else []) + \
+        (["--module"] if args.module else []) + \
+        ["--", args.user_script] + args.user_args
+
+
+def build_multinode_cmds(args, world_info: Dict[str, List[int]],
+                         master_addr: str) -> List[List[str]]:
+    """One remote command per host (ssh) or a single pdsh fan-out."""
+    env_exports = " ".join(
+        f"{k}={shlex.quote(os.environ[k])}" for k in EXPORT_ENVS
+        if k in os.environ)
+    cmds = []
+    hosts = list(world_info)
+    if args.launcher == "pdsh":
+        launch = build_launch_cmd(args, world_info, -1, master_addr)
+        # pdsh exports %n as the host index for the node rank
+        remote = f"cd {shlex.quote(os.getcwd())} && {env_exports} " + \
+            " ".join(shlex.quote(c) for c in launch)
+        remote = remote.replace("--node_rank=-1", "--node_rank=%n")
+        return [["pdsh", "-S", "-f", "1024", "-w", ",".join(hosts)] +
+                shlex.split(args.launcher_args) + [remote]]
+    for rank, host in enumerate(hosts):
+        launch = build_launch_cmd(args, world_info, rank, master_addr)
+        remote = f"cd {shlex.quote(os.getcwd())} && {env_exports} " + \
+            " ".join(shlex.quote(c) for c in launch)
+        cmds.append(["ssh"] + shlex.split(args.launcher_args) +
+                    [host, remote])
+    return cmds
+
+
+# ------------------------------------------------------------------ #
+# main
+# ------------------------------------------------------------------ #
+def main(args=None) -> int:
+    args = parse_args(args)
+    pool = fetch_hostfile(args.hostfile)
+
+    if pool is None:  # local machine only
+        n = args.num_gpus if args.num_gpus > 0 else 1
+        world_info = {"localhost": list(range(n))}
+    else:
+        world_info = parse_inclusion_exclusion(pool, args.include,
+                                               args.exclude)
+        if args.num_nodes > 0:
+            world_info = OrderedDict(
+                list(world_info.items())[:args.num_nodes])
+        if args.num_gpus > 0:
+            # cap per-host slots, keeping the filtered slot IDs
+            for h, slots in world_info.items():
+                if len(slots) < args.num_gpus:
+                    raise ValueError(
+                        f"host {h} has only {len(slots)} usable slots, "
+                        f"--num_gpus={args.num_gpus} requested")
+            world_info = OrderedDict(
+                (h, slots[:args.num_gpus])
+                for h, slots in world_info.items())
+    if not world_info:
+        raise ValueError("no hosts left after filtering")
+
+    if args.elastic_training:
+        from deepspeed_tpu.elasticity import compute_elastic_config  # noqa: F401
+
+        logger.info("elastic training: batch plan comes from the config's "
+                    "'elasticity' block at engine init")
+
+    master_addr = args.master_addr or next(iter(world_info))
+    multi = (len(world_info) > 1 or args.force_multi) and \
+        args.launcher != "local"
+    if not multi:
+        cmd = build_launch_cmd(args, world_info, 0, master_addr or
+                               "localhost")
+        logger.info(f"launching: {' '.join(cmd)}")
+        return subprocess.call(cmd)
+
+    cmds = build_multinode_cmds(args, world_info, master_addr)
+    procs = [subprocess.Popen(c) for c in cmds]
+    rc = 0
+    for p in procs:
+        rc = rc or p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

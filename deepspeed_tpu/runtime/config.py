@@ -342,12 +342,40 @@ class DeepSpeedConfig:
     def dynamic_loss_scale(self) -> bool:
         return self.fp16.enabled and self.fp16.loss_scale == 0
 
-    def resolve_batch_size(self, dp_world_size: int) -> None:
+    def resolve_batch_size(self, dp_world_size: int,
+                           world_size: int = 0) -> None:
         """Batch trio algebra (reference runtime/config.py
         ``_configure_train_batch_size``): any two of
-        {train_batch_size, micro_batch, gas} determine the third."""
+        {train_batch_size, micro_batch, gas} determine the third.
+
+        ``world_size`` is the TOTAL device count (dp × mp × ...), which
+        elasticity v0.2 consumes; defaults to ``dp_world_size`` (correct
+        when model parallelism is off).
+        """
         tb, mb, gas = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
                        self.gradient_accumulation_steps)
+        # Elasticity overrides the trio (reference runtime/config.py elastic
+        # dict hook + elasticity/elasticity.py compute_elastic_config)
+        if self.elasticity.get("enabled", False):
+            from deepspeed_tpu.elasticity import (
+                compute_elastic_config, ensure_immutable_elastic_config)
+            from deepspeed_tpu.version import __version__
+
+            if (tb is not None or mb is not None or gas is not None) and \
+                    not self.elasticity.get("ignore_non_elastic_batch_info",
+                                            False):
+                raise ValueError(
+                    "elasticity is enabled but batch sizes / gradient "
+                    "accumulation are also set; remove them or set "
+                    "elasticity.ignore_non_elastic_batch_info")
+            ensure_immutable_elastic_config(self.elasticity)
+            tb, _valid, mb = compute_elastic_config(
+                {"elasticity": self.elasticity}, __version__,
+                world_size=world_size or dp_world_size,
+                return_microbatch=True)
+            gas = tb // (mb * dp_world_size) if mb else None
+            logger.info(f"elasticity: train_batch_size={tb} "
+                        f"micro_batch={mb} gas={gas}")
         if tb is not None and mb is not None and gas is not None:
             if tb != mb * gas * dp_world_size:
                 raise ValueError(

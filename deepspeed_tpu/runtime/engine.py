@@ -60,6 +60,19 @@ from deepspeed_tpu.utils.timer import (FORWARD_MICRO_TIMER, STEP_MICRO_TIMER,
 BATCH_AXES = GROUP_ALIASES["dp"]  # ('dout','data','expert')
 
 
+def _shapes_match(args, shapes) -> bool:
+    """True when ``args`` has exactly the (shape, dtype) tree the AOT
+    executable was compiled for."""
+    try:
+        a = jax.tree.leaves(jax.tree.map(
+            lambda x: (tuple(x.shape), jnp.dtype(x.dtype).name), args))
+        b = jax.tree.leaves(jax.tree.map(
+            lambda x: (tuple(x.shape), jnp.dtype(x.dtype).name), shapes))
+        return a == b
+    except Exception:  # noqa: BLE001 — any mismatch means "retrace"
+        return False
+
+
 def _as_model_fns(model, loss_fn) -> Tuple[Callable, Callable]:
     """Normalise a model into (init_fn, apply_fn).
 
@@ -139,7 +152,8 @@ class DeepSpeedEngine:
 
         # Batch trio over the data-parallel axes (reference engine dp_world_size)
         self.dp_world_size = self.topology.axis_size("dp")
-        self.config.resolve_batch_size(self.dp_world_size)
+        self.config.resolve_batch_size(self.dp_world_size,
+                                       world_size=self.topology.world_size)
 
         self.loss_fn = loss_fn
         self.module = model
@@ -264,6 +278,7 @@ class DeepSpeedEngine:
         self._jit_eval: Optional[Callable] = None
         self._micro_compiled = None  # AOT executables (flops profiler path)
         self._apply_compiled = None
+        self._apply_in_shapes = None
         self._shardings: Optional[Dict[str, Any]] = None
         self._rng = jax.random.key(self.config.seed)
 
@@ -583,11 +598,13 @@ class DeepSpeedEngine:
         if self.config.flops_profiler.enabled:
             # AOT-compile once and reuse the executable for both execution
             # and the profiler's cost_analysis — no duplicate compile at
-            # profile_step.
+            # profile_step. A shape change (e.g. a final partial batch)
+            # falls back to the retracing jit path.
             if self._micro_compiled is None:
                 self._micro_compiled = self._jit_micro.lower(
                     *self._micro_in_shapes).compile()
-            micro_fn = self._micro_compiled
+            if _shapes_match(inputs, self._micro_in_shapes):
+                micro_fn = self._micro_compiled
         self.state["acc_grads"], loss = micro_fn(*inputs)
         self.timers(FORWARD_MICRO_TIMER).stop(
             sync_obj=loss if self.config.wall_clock_breakdown else None)
@@ -644,16 +661,18 @@ class DeepSpeedEngine:
             self._offload_transfer(to_host=False)
         apply_fn = self._jit_apply
         if self.config.flops_profiler.enabled:
+            state_sh = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=getattr(x, "sharding", None)), self.state)
+            lr_sh = jax.ShapeDtypeStruct(
+                (), jnp.float32, sharding=NamedSharding(self.mesh, P()))
             if self._apply_compiled is None:
-                state_sh = jax.tree.map(
-                    lambda x: jax.ShapeDtypeStruct(
-                        x.shape, x.dtype,
-                        sharding=getattr(x, "sharding", None)), self.state)
-                lr_sh = jax.ShapeDtypeStruct(
-                    (), jnp.float32, sharding=NamedSharding(self.mesh, P()))
                 self._apply_compiled = self._jit_apply.lower(
                     state_sh, lr_sh).compile()
-            apply_fn = self._apply_compiled
+                self._apply_in_shapes = (state_sh, lr_sh)
+            if _shapes_match((self.state, lr), self._apply_in_shapes):
+                apply_fn = self._apply_compiled
         self.state, gnorm, overflow = apply_fn(self.state, lr)
         if self._offload_plan is not None:
             self._offload_transfer(to_host=True)

@@ -38,6 +38,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.ops.quantizer import dequantize, quantize, quantized_reduce
+from deepspeed_tpu.parallel.topology import GROUP_ALIASES
 
 DEFAULT_GROUP_SIZE = 256
 
@@ -172,7 +173,7 @@ def build_quantized_micro(engine) -> Any:
 
     param_specs = jax.tree.map(lambda s: s.spec, sh["params"])
     grad_specs = jax.tree.map(lambda s: s.spec, sh["acc_grads"])
-    batch_spec = P(("dout", "data", "expert"))
+    batch_spec = P(GROUP_ALIASES["dp"])
 
     def gather_params(params_local):
         def one(p, spec):
@@ -226,11 +227,8 @@ def build_quantized_micro(engine) -> Any:
         loss = lax.pmean(loss, dp_axes)
         return acc, loss
 
-    wrap_spec = lambda tree: jax.tree.map(
-        lambda s: s, tree, is_leaf=lambda x: isinstance(x, P))
     scalar = P()
-    in_specs = (wrap_spec(param_specs), wrap_spec(grad_specs), scalar,
-                scalar)
+    in_specs = (param_specs, grad_specs, scalar, scalar)
 
     def micro(params, acc_grads, scale, rng, *args):
         arg_specs = tuple(
@@ -238,7 +236,7 @@ def build_quantized_micro(engine) -> Any:
         f = jax.shard_map(
             micro_local, mesh=mesh,
             in_specs=in_specs + arg_specs,
-            out_specs=(wrap_spec(grad_specs), P()),
+            out_specs=(grad_specs, P()),
             check_vma=False)
         return f(params, acc_grads, scale, rng, *args)
 

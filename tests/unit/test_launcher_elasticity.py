@@ -1,0 +1,211 @@
+"""Launcher + elasticity (reference: tests/unit/launcher/test_run.py,
+tests/unit/elasticity/test_elastic.py)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+)
+from deepspeed_tpu.elasticity.elasticity import (
+    _get_compatible_gpus_v01,
+    highly_composite_numbers,
+)
+from deepspeed_tpu.launcher import runner as ds_runner
+
+
+# ------------------------------------------------------------------ #
+# hostfile / filters / world info
+# ------------------------------------------------------------------ #
+def _hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+def test_fetch_hostfile(tmp_path):
+    path = _hostfile(tmp_path, """
+# comment
+worker-0 slots=4
+worker-1 slots=2
+worker-2
+""")
+    pool = ds_runner.fetch_hostfile(path)
+    assert pool == {"worker-0": 4, "worker-1": 2, "worker-2": 1}
+
+
+def test_fetch_hostfile_rejects_duplicates(tmp_path):
+    path = _hostfile(tmp_path, "h slots=2\nh slots=4\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        ds_runner.fetch_hostfile(path)
+
+
+def test_include_filter():
+    pool = {"w0": 4, "w1": 4}
+    got = ds_runner.parse_inclusion_exclusion(pool, "w0:0,2@w1", "")
+    assert got == {"w0": [0, 2], "w1": [0, 1, 2, 3]}
+
+
+def test_exclude_filter():
+    pool = {"w0": 4, "w1": 2}
+    got = ds_runner.parse_inclusion_exclusion(pool, "", "w0:1,3@w1")
+    assert got == {"w0": [0, 2]}
+
+
+def test_filters_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ds_runner.parse_inclusion_exclusion({"w0": 1}, "w0", "w0")
+
+
+def test_world_info_roundtrip():
+    info = {"a": [0, 1], "b": [0]}
+    assert ds_runner.decode_world_info(ds_runner.encode_world_info(info)) \
+        == info
+
+
+def test_multinode_cmds_contain_rendezvous():
+    args = ds_runner.parse_args(
+        ["--master_port", "12345", "train.py", "--foo"])
+    info = {"w0": [0], "w1": [0]}
+    cmds = ds_runner.build_multinode_cmds(args, info, "w0")
+    assert len(cmds) == 2
+    assert cmds[0][0] == "ssh" and cmds[0][1] == "w0"
+    assert "--node_rank=1" in cmds[1][-1]
+    assert "--master_addr=w0" in cmds[0][-1]
+    assert "train.py" in cmds[0][-1]
+
+
+def test_local_launch_runs_user_script(tmp_path):
+    """End-to-end single-host launch: 2 local slots, each child sees its
+    RANK/WORLD_SIZE env."""
+    script = tmp_path / "child.py"
+    out = tmp_path / "out"
+    script.write_text(
+        "import os\n"
+        f"open(r'{out}' + os.environ['RANK'], 'w').write(\n"
+        "    os.environ['RANK'] + '/' + os.environ['WORLD_SIZE'] + '/' +\n"
+        "    os.environ['COORDINATOR_ADDRESS'])\n")
+    info = ds_runner.encode_world_info({"localhost": [0, 1]})
+    rc = subprocess.call(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+         f"--world_info={info}", "--node_rank=0",
+         "--master_addr=localhost", "--master_port=23456",
+         "--", str(script)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert rc == 0
+    assert (tmp_path / "out0").read_text() == "0/2/localhost:23456"
+    assert (tmp_path / "out1").read_text() == "1/2/localhost:23456"
+
+
+def test_launch_propagates_child_failure(tmp_path):
+    script = tmp_path / "boom.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    info = ds_runner.encode_world_info({"localhost": [0]})
+    rc = subprocess.call(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+         f"--world_info={info}", "--node_rank=0",
+         "--master_addr=localhost", "--master_port=23456",
+         "--", str(script)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert rc == 3
+
+
+# ------------------------------------------------------------------ #
+# elasticity
+# ------------------------------------------------------------------ #
+def test_hcn_sequence_matches_reference_prefix():
+    # the reference HCN_LIST is the true highly-composite sequence
+    want = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+            1260]
+    got = highly_composite_numbers(1260)
+    assert got[:len(want)] == want
+
+
+def test_v01_hand_computed_case():
+    # micro batches {2,4}, ceiling 20: candidates 12 (2x6) and 16 (4x4,
+    # lcm 4x4); both admit 4 device counts; prefer_larger -> 16
+    batch, valid = _get_compatible_gpus_v01([2, 4], 20)
+    assert batch == 16
+    assert valid == [1, 2, 4, 8]
+
+
+def test_v01_prefer_smaller():
+    batch, _ = _get_compatible_gpus_v01([2, 4], 20, prefer_larger=False)
+    assert batch == 12
+
+
+def test_v01_gpu_range_filter():
+    _, valid = _get_compatible_gpus_v01([2, 4], 20, min_gpus=2, max_gpus=4)
+    assert valid == [2, 4]
+
+
+def test_compute_elastic_config_v01():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 10000,
+                          "micro_batch_sizes": [8, 12, 16, 17],
+                          "min_gpus": 32, "max_gpus": 1500,
+                          "version": 0.1}}
+    batch, valid = compute_elastic_config(cfg, "0.12.7")
+    assert batch <= 10000
+    for w in valid:
+        assert 32 <= w <= 1500
+        assert any(batch % (m * w) == 0 for m in [8, 12, 16, 17])
+
+
+def test_compute_elastic_config_incompatible_world_size():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 16,
+                          "micro_batch_sizes": [4], "version": 0.1}}
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, "0.12.7", world_size=3)
+
+
+def test_compute_elastic_config_v02_microbatch():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 2048,
+                          "micro_batch_sizes": [2, 4, 8],
+                          "min_gpus": 1, "max_gpus": 128,
+                          "num_gpus_per_node": 8,
+                          "model_parallel_size": 2,
+                          "version": 0.2}}
+    batch, valid, micro = compute_elastic_config(
+        cfg, "0.12.7", world_size=16, return_microbatch=True)
+    assert micro in (2, 4, 8)
+    assert batch % micro == 0
+    # dp counts are whole-node multiples of 8/2 = 4
+    assert all(v % 4 == 0 for v in valid)
+
+
+def test_elasticity_requires_enabled():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": False}}, "0.12.7")
+
+
+def test_old_version_rejected():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 100,
+                          "micro_batch_sizes": [2], "version": 0.1}}
+    from deepspeed_tpu.elasticity import ElasticityError
+
+    with pytest.raises(ElasticityError, match="older"):
+        compute_elastic_config(cfg, "0.0.1")
+
+
+def test_engine_config_elastic_batch():
+    """Elasticity plugs into the config batch trio (reference
+    runtime/config.py elastic hook)."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    cfg = DeepSpeedConfig({
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "elasticity": {"enabled": True, "max_train_batch_size": 1024,
+                       "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                       "max_gpus": 64, "version": 0.2,
+                       "num_gpus_per_node": 1},
+    })
+    cfg.resolve_batch_size(dp_world_size=8)
+    assert cfg.train_batch_size <= 1024
+    assert cfg.train_micro_batch_size_per_gpu in (2, 4)
+    assert cfg.train_batch_size == (cfg.train_micro_batch_size_per_gpu *
+                                    cfg.gradient_accumulation_steps * 8)
