@@ -108,11 +108,13 @@ def main(args=None) -> int:
                 if r is None:
                     continue
                 procs.remove(p)
-                if r != 0:
+                # keep the FIRST failure's code: siblings we SIGTERM below
+                # exit -15 and must not clobber it
+                if r != 0 and rc == 0:
                     logger.error(f"child {p.pid} exited rc={r}; "
                                  f"terminating local group")
-                    _terminate()
                     rc = r
+                    _terminate()
             if procs:
                 import time
 

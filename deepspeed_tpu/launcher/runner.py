@@ -241,10 +241,18 @@ def main(args=None) -> int:
         raise ValueError("no hosts left after filtering")
 
     if args.elastic_training:
-        from deepspeed_tpu.elasticity import compute_elastic_config  # noqa: F401
-
-        logger.info("elastic training: batch plan comes from the config's "
-                    "'elasticity' block at engine init")
+        # The batch plan itself comes from the config's 'elasticity' block
+        # at engine init; the launcher enforces the node bounds.
+        n_nodes = len(world_info)
+        lo = args.min_elastic_nodes if args.min_elastic_nodes > 0 else 1
+        hi = args.max_elastic_nodes if args.max_elastic_nodes > 0 else n_nodes
+        if not (lo <= n_nodes <= hi):
+            raise ValueError(
+                f"elastic training: {n_nodes} nodes outside "
+                f"[{lo}, {hi}] (--min/max_elastic_nodes)")
+        os.environ["DS_ELASTIC_NODE_RANGE"] = f"{lo},{hi}"
+        logger.info(f"elastic training over {n_nodes} nodes "
+                    f"(allowed range [{lo}, {hi}])")
 
     master_addr = args.master_addr or next(iter(world_info))
     multi = (len(world_info) > 1 or args.force_multi) and \

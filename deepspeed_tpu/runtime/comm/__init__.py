@@ -1,0 +1,10 @@
+"""Compressed-communication backends (reference: deepspeed/runtime/comm/ —
+NcclBackend/MpiBackend 1-bit allreduce)."""
+
+from deepspeed_tpu.runtime.comm.compressed import (
+    compressed_allreduce,
+    pack_signs,
+    unpack_signs,
+)
+
+__all__ = ["compressed_allreduce", "pack_signs", "unpack_signs"]

@@ -1,0 +1,91 @@
+"""Error-feedback sign-compressed allreduce — the 1-bit collective
+(reference: runtime/comm/nccl.py ``NcclBackend.compressed_allreduce``,
+mpi.py, hccl.py; cupy packbits compression runtime/compression/cupy.py).
+
+The wire carries ONE BIT per element (signs packed 8-per-uint8) plus one
+fp32 scale per worker/chunk; quantization error is fed back into the next
+round locally (worker error) and at the reduction point (server error), so
+the running average stays unbiased — the property 1-bit Adam/LAMB rely on.
+
+Two hops, exactly the reference topology:
+
+1. **worker → chunk owner**: each device sign-compresses its compensated
+   tensor, all-to-alls chunk ``i`` to device ``i`` (+ all-gather of the
+   per-worker scales);
+2. **chunk owner → all**: the owner averages its W decompressed chunks,
+   compensates with its server error, re-compresses, and all-gathers the
+   result.
+
+Call inside ``shard_map`` over the data-parallel axes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+_BITS = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
+
+
+def pack_signs(x: jnp.ndarray) -> jnp.ndarray:
+    """Signs of ``x`` (>=0 → 1) packed 8 per uint8. Size must divide by 8."""
+    bits = (x >= 0).reshape(-1, 8).astype(jnp.uint8)
+    return (bits * _BITS).sum(axis=1).astype(jnp.uint8)
+
+
+def unpack_signs(packed: jnp.ndarray) -> jnp.ndarray:
+    """uint8 → ±1.0 float32 array of 8x the length."""
+    bits = (packed[:, None] & _BITS[None, :]) > 0
+    return jnp.where(bits, 1.0, -1.0).reshape(-1).astype(jnp.float32)
+
+
+def _scale_of(x: jnp.ndarray) -> jnp.ndarray:
+    """Reference worker/server scale: ||x|| / sqrt(numel) — the magnitude a
+    unit sign vector needs to preserve the l2 norm."""
+    return jnp.linalg.norm(x) / jnp.sqrt(jnp.float32(x.size))
+
+
+def compressed_allreduce(x: jnp.ndarray, worker_error: jnp.ndarray,
+                         server_error: jnp.ndarray,
+                         axis_names: Tuple[str, ...],
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """1-bit mean-allreduce of ``x`` across ``axis_names``.
+
+    ``x``/``worker_error``: flat [N] with N % (W*8) == 0;
+    ``server_error``: flat [N // W]. Returns (mean, worker_error',
+    server_error') — the errors feed the NEXT call (error feedback).
+    """
+    world = 1
+    for a in axis_names:
+        world *= lax.axis_size(a)
+    n = x.size
+    if n % (world * 8) != 0:
+        raise ValueError(f"size {n} must be divisible by world*8 = "
+                         f"{world * 8} (pad before calling)")
+    chunk = n // world
+
+    # hop 1: worker compress + chunk exchange
+    compensated = x.astype(jnp.float32) + worker_error
+    w_scale = _scale_of(compensated)
+    new_worker_error = compensated - w_scale * jnp.sign(compensated)
+
+    packed = pack_signs(compensated).reshape(world, chunk // 8)
+    recv = lax.all_to_all(packed, axis_names, split_axis=0, concat_axis=0,
+                          tiled=False).reshape(world, chunk // 8)
+    scales = lax.all_gather(w_scale, axis_names)          # [W]
+
+    signs = unpack_signs(recv.reshape(-1)).reshape(world, chunk)
+    chunk_avg = (signs * scales[:, None]).mean(axis=0)
+
+    # hop 2: server compress + broadcast
+    comp_server = chunk_avg + server_error
+    s_scale = _scale_of(comp_server)
+    new_server_error = comp_server - s_scale * jnp.sign(comp_server)
+    s_packed = pack_signs(comp_server)
+    all_packed = lax.all_gather(s_packed, axis_names)      # [W, chunk//8]
+    all_scales = lax.all_gather(s_scale, axis_names)       # [W]
+    out = unpack_signs(all_packed.reshape(-1)).reshape(world, chunk) * \
+        all_scales[:, None]
+    return out.reshape(-1), new_worker_error, new_server_error

@@ -373,7 +373,14 @@ class DeepSpeedConfig:
                 {"elasticity": self.elasticity}, __version__,
                 world_size=world_size or dp_world_size,
                 return_microbatch=True)
-            gas = tb // (mb * dp_world_size) if mb else None
+            if mb is None:
+                raise ValueError(
+                    f"elasticity: batch size {tb} is not reachable with any "
+                    f"declared micro_batch_sizes "
+                    f"{self.elasticity.get('micro_batch_sizes')} at "
+                    f"dp={dp_world_size}; change the world size or widen "
+                    f"micro_batch_sizes")
+            gas = tb // (mb * dp_world_size)
             logger.info(f"elasticity: train_batch_size={tb} "
                         f"micro_batch={mb} gas={gas}")
         if tb is not None and mb is not None and gas is not None:

@@ -243,8 +243,18 @@ class DeepSpeedEngine:
         else:
             opt_cfg_type, opt_params = opt_cfg.type, dict(opt_cfg.params)
         self._base_lr = float(opt_params.get("lr", 1e-3))
+        from deepspeed_tpu.runtime.fp16 import onebit as onebit_mod  # registers
+
         self.optimizer_def: OptimizerDef = get_optimizer(opt_cfg_type, opt_params)
         self.optimizer = self  # reference returns engine.optimizer; state lives here
+        # 1-bit optimizers own gradient communication (reference
+        # runtime/fp16/onebit/): per-device grad accumulation + compressed
+        # momentum allreduce after freeze_step.
+        self._onebit = self.optimizer_def.name in onebit_mod.ONEBIT_NAMES
+        self._jit_apply_compressed = None
+        self._onebit_update_var = None
+        if self._onebit:
+            self._onebit_world = onebit_mod.validate_onebit_mesh(self)
 
         # lr scheduler ------------------------------------------------------
         if lr_scheduler is not None:
@@ -358,6 +368,16 @@ class DeepSpeedEngine:
             "acc_grads": grad_s,
             "loss_scale": scalar, "good_steps": scalar, "hysteresis": scalar,
         }
+        if self._onebit:
+            # per-device grad accumulator [W, ...] + comm error feedback
+            # state, all sharded over the dp axes on dim 0
+            dev_sharded = NamedSharding(mesh, P(BATCH_AXES))
+            self._shardings["acc_grads"] = jax.tree.map(
+                lambda _s: dev_sharded, grad_s)
+            self._shardings["comm_error_worker"] = jax.tree.map(
+                lambda _s: dev_sharded, grad_s)
+            self._shardings["comm_error_server"] = jax.tree.map(
+                lambda _s: dev_sharded, grad_s)
         if self._offload_device:
             from deepspeed_tpu.runtime.zero.offload import OffloadPlan
 
@@ -406,8 +426,14 @@ class DeepSpeedEngine:
         return self.state
 
     def _make_state(self, params32):
-        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params32)
-        return {
+        if self._onebit:
+            w = self._onebit_world
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros((w,) + p.shape, jnp.float32), params32)
+        else:
+            zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                 params32)
+        state = {
             "step": jnp.zeros((), jnp.int32),
             "opt_step": jnp.zeros((), jnp.int32),
             "params": jax.tree.map(lambda p: p.astype(self.compute_dtype), params32),
@@ -418,6 +444,13 @@ class DeepSpeedEngine:
             "good_steps": jnp.zeros((), jnp.int32),
             "hysteresis": jnp.asarray(self.config.fp16.hysteresis, jnp.int32),
         }
+        if self._onebit:
+            from deepspeed_tpu.runtime.fp16.onebit import make_error_state
+
+            werr, serr = make_error_state(params32, self._onebit_world)
+            state["comm_error_worker"] = werr
+            state["comm_error_server"] = serr
+        return state
 
     # ------------------------------------------------------------------ #
     # Batch placement
@@ -455,6 +488,11 @@ class DeepSpeedEngine:
         """The micro program reads ONLY (params, acc_grads, loss_scale) —
         master weights and optimizer moments never flow through it, so with
         offload enabled they stay host-resident across micro-steps."""
+        if self._onebit:
+            from deepspeed_tpu.runtime.fp16.onebit import build_local_grad_micro
+
+            self._jit_micro = build_local_grad_micro(self)
+            return
         zc = self.config.zero_config
         if (zc.zero_quantized_weights and self.zero_stage >= 3) or \
                 zc.zero_quantized_gradients:
@@ -494,9 +532,15 @@ class DeepSpeedEngine:
         dynamic = self.dynamic_loss_scale
         cfg = self.config.fp16
 
+        onebit = self._onebit
+
         def apply_step(state, lr):
             inv_scale = 1.0 / state["loss_scale"]
             grads = jax.tree.map(lambda g: g * inv_scale, state["acc_grads"])
+            if onebit:
+                # warmup phase: average the per-device accumulators in full
+                # precision (XLA reduces the dp-sharded leading dim)
+                grads = jax.tree.map(lambda g: g.mean(axis=0), grads)
             # global grad norm (sharded leaves -> XLA inserts the reduction)
             sumsq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
             gnorm = jnp.sqrt(sumsq)
@@ -539,7 +583,9 @@ class DeepSpeedEngine:
             else:
                 new_scale, new_good, new_hyst = scale, good, hyst
 
-            new_state = {
+            new_state = dict(state)  # passthrough for extra keys (1-bit
+            # comm errors stay zero through warmup)
+            new_state.update({
                 "step": state["step"] + 1,
                 "opt_step": jnp.where(overflow, state["opt_step"], opt_step_next),
                 "params": jax.tree.map(
@@ -550,7 +596,7 @@ class DeepSpeedEngine:
                 "loss_scale": new_scale,
                 "good_steps": new_good,
                 "hysteresis": new_hyst,
-            }
+            })
             return new_state, gnorm, overflow
 
         scalar = NamedSharding(self.mesh, P())
@@ -653,6 +699,8 @@ class DeepSpeedEngine:
         (reference engine.step:2111 -> _take_model_step:2045)"""
         if not self.is_gradient_accumulation_boundary():
             return
+        if self._onebit_compression_stage():
+            return self._onebit_step()
         if self._jit_apply is None:
             self._build_apply()
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
@@ -661,13 +709,13 @@ class DeepSpeedEngine:
             self._offload_transfer(to_host=False)
         apply_fn = self._jit_apply
         if self.config.flops_profiler.enabled:
-            state_sh = jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(
-                    x.shape, x.dtype,
-                    sharding=getattr(x, "sharding", None)), self.state)
-            lr_sh = jax.ShapeDtypeStruct(
-                (), jnp.float32, sharding=NamedSharding(self.mesh, P()))
             if self._apply_compiled is None:
+                state_sh = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        x.shape, x.dtype,
+                        sharding=getattr(x, "sharding", None)), self.state)
+                lr_sh = jax.ShapeDtypeStruct(
+                    (), jnp.float32, sharding=NamedSharding(self.mesh, P()))
                 self._apply_compiled = self._jit_apply.lower(
                     state_sh, lr_sh).compile()
                 self._apply_in_shapes = (state_sh, lr_sh)
@@ -739,6 +787,47 @@ class DeepSpeedEngine:
         prof.print_model_profile(profile_step=fp.profile_step,
                                  detailed=fp.detailed,
                                  output_file=fp.output_file)
+
+    def _onebit_compression_stage(self) -> bool:
+        return self._onebit and self.global_steps >= \
+            int(self.optimizer_def.hyperparams.get("freeze_step", 0))
+
+    def _onebit_step(self):
+        """Compression-stage optimizer step: 1-bit momentum allreduce
+        (reference onebit/adam.py post-freeze path)."""
+        from deepspeed_tpu.runtime.fp16.onebit import build_compressed_apply
+
+        hp = self.optimizer_def.hyperparams
+        update_var = (self.optimizer_def.name == "zerooneadam" and
+                      self.global_steps < int(hp.get("var_freeze_step", 0)))
+        if self._jit_apply_compressed is None or \
+                update_var != self._onebit_update_var:
+            log_dist(
+                f"1-bit {self.optimizer_def.name}: entering compression "
+                f"stage at step {self.global_steps} "
+                f"(update_variance={update_var})", ranks=[0])
+            self._jit_apply_compressed = build_compressed_apply(
+                self, update_variance=update_var)
+            self._onebit_update_var = update_var
+        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        self.timers(STEP_MICRO_TIMER).start()
+        self.state, gnorm, overflow = self._jit_apply_compressed(
+            self.state, lr)
+        self.timers(STEP_MICRO_TIMER).stop(
+            sync_obj=self.state["loss_scale"]
+            if self.config.wall_clock_breakdown else None)
+        self.tput_timer.stop(global_step=True, sync_obj=None)
+        self.global_steps += 1
+        if self.fp16_enabled and bool(jax.device_get(overflow)):
+            self.skipped_steps += 1
+            log_dist(
+                f"step {self.global_steps}: fp16 overflow in 1-bit apply, "
+                f"skipping update (loss scale -> "
+                f"{float(jax.device_get(self.state['loss_scale']))})",
+                ranks=[0])
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step(self.global_steps)
+        return gnorm
 
     def train(self, mode: bool = True):
         self.training = mode

@@ -1,0 +1,291 @@
+"""1-bit optimizers (reference: runtime/fp16/onebit/adam.py:306 OnebitAdam,
+lamb.py OnebitLamb, zoadam.py ZeroOneAdam).
+
+Algorithm (1-bit Adam): a **warmup** phase runs plain Adam with
+full-precision gradient averaging while the variance term stabilises; after
+``freeze_step`` the variance is frozen and each step communicates only the
+sign-compressed *momentum* via :func:`compressed_allreduce` (error feedback
+keeps the running average unbiased). Communication volume drops ~32x
+(fp32 → 1 bit + scales).
+
+Engine integration (both programs require a pure data-parallel mesh and
+ZeRO stage 0 — params/grads replicated, matching the reference's
+1-bit/ZeRO incompatibility):
+
+* :func:`build_local_grad_micro` — micro-step whose accumulated gradients
+  keep a leading ``[W, ...]`` device axis (sharded over dp) and are NOT
+  cross-device reduced: the optimizer owns communication.
+* :func:`build_compressed_apply` — shard_map optimizer step: local momentum
+  update → 1-bit allreduce → frozen-variance Adam/LAMB update.
+
+The warmup phase reuses the engine's standard apply with the grads averaged
+over the device axis (full-precision comm, as the reference does).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.ops.optimizers import (OptimizerDef, _tree_zeros_like,
+                                          register_optimizer)
+from deepspeed_tpu.parallel.topology import GROUP_ALIASES
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+
+ONEBIT_NAMES = ("onebitadam", "onebitlamb", "zerooneadam")
+DP_AXES = ("dout", "data")
+
+
+def _no_bias_correction_adam_update(b1, b2, eps, weight_decay):
+    """The shared onebit update rule: the reference's compression-stage
+    formula ``exp_avg / (sqrt(exp_avg_sq) + eps)`` without bias correction
+    (onebit/adam.py step)."""
+
+    def update(grads, state, master, lr_t, step):
+        def upd(g, m, v, p):
+            g = g.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+            stepval = m_new / (jnp.sqrt(v_new) + eps)
+            if weight_decay > 0.0:
+                stepval = stepval + weight_decay * p
+            return p - lr_t * stepval, m_new, v_new
+
+        out = jax.tree.map(upd, grads, state["m"], state["v"], master)
+        pick = lambda i: jax.tree.map(lambda o: o[i], out,
+                                      is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), {"m": pick(1), "v": pick(2)}
+
+    return update
+
+
+def _make_onebit(name: str):
+    def factory(lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                weight_decay: float = 0.0, freeze_step: int = 100000,
+                var_freeze_step: int = None, cuda_aware: bool = False,
+                comm_backend_name: str = "xla",
+                max_coeff: float = 0.3, min_coeff: float = 0.01,
+                **_unused) -> OptimizerDef:
+        b1, b2 = betas
+
+        def init(master):
+            return {"m": _tree_zeros_like(master),
+                    "v": _tree_zeros_like(master)}
+
+        return OptimizerDef(
+            name, init,
+            _no_bias_correction_adam_update(b1, b2, eps, weight_decay),
+            dict(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+                 freeze_step=freeze_step,
+                 var_freeze_step=(var_freeze_step if var_freeze_step
+                                  is not None else freeze_step),
+                 max_coeff=max_coeff, min_coeff=min_coeff))
+
+    return factory
+
+
+onebit_adam = _make_onebit("onebitadam")
+onebit_lamb = _make_onebit("onebitlamb")
+zero_one_adam = _make_onebit("zerooneadam")
+
+register_optimizer("onebitadam", onebit_adam)
+register_optimizer("onebitlamb", onebit_lamb)
+register_optimizer("zerooneadam", zero_one_adam)
+
+
+# ------------------------------------------------------------------ #
+# error-state geometry
+# ------------------------------------------------------------------ #
+def padded_numel(shape: Tuple[int, ...], world: int) -> int:
+    n = int(np.prod(shape)) if shape else 1
+    unit = world * 8
+    return ((n + unit - 1) // unit) * unit
+
+
+def validate_onebit_mesh(engine) -> int:
+    topo = engine.topology
+    for axis in ("model", "seq", "expert", "pipe"):
+        if topo.get_dim(axis) != 1:
+            raise ValueError(
+                f"1-bit optimizers require a pure data-parallel mesh "
+                f"(got {axis}={topo.get_dim(axis)})")
+    if engine.zero_stage != 0:
+        raise ValueError(
+            "1-bit optimizers own gradient communication and are "
+            "incompatible with ZeRO sharding (reference constraint); set "
+            "zero_optimization.stage to 0")
+    return topo.get_dim("dout") * topo.get_dim("data")
+
+
+def make_error_state(params_shapes, world: int):
+    """comm-error pytrees: worker [W, Npad], server [W, Npad/W] per leaf."""
+    def w_leaf(l):
+        return jnp.zeros((world, padded_numel(tuple(l.shape), world)),
+                         jnp.float32)
+
+    def s_leaf(l):
+        return jnp.zeros(
+            (world, padded_numel(tuple(l.shape), world) // world),
+            jnp.float32)
+
+    shapes = params_shapes
+    return (jax.tree.map(w_leaf, shapes), jax.tree.map(s_leaf, shapes))
+
+
+# ------------------------------------------------------------------ #
+# engine programs
+# ------------------------------------------------------------------ #
+def build_local_grad_micro(engine):
+    """Micro-step with per-device (unreduced) gradient accumulation."""
+    world = validate_onebit_mesh(engine)
+    mesh = engine.mesh
+    sh = engine._state_shardings()
+    gas = engine._grad_accum_divisor()
+    param_specs = jax.tree.map(lambda s: s.spec, sh["params"])
+    acc_specs = jax.tree.map(lambda s: s.spec, sh["acc_grads"])
+    batch_spec = P(GROUP_ALIASES["dp"])
+
+    def micro_local(params, acc_grads, scale, rng, *args):
+        def scaled_loss_fn(p):
+            out = engine._apply_fn(p, *args, rng=rng, train=True)
+            loss, _aux = engine._loss_from_outputs(out, args)
+            return loss.astype(jnp.float32) * (scale / gas), loss
+
+        (_, loss), grads = jax.value_and_grad(scaled_loss_fn,
+                                              has_aux=True)(params)
+        acc = jax.tree.map(
+            lambda a, g: a + g.astype(jnp.float32)[None], acc_grads, grads)
+        return acc, lax.pmean(loss, DP_AXES)
+
+    def micro(params, acc_grads, scale, rng, *args):
+        arg_specs = tuple(
+            batch_spec if getattr(a, "ndim", 0) >= 1 else P() for a in args)
+        f = jax.shard_map(
+            micro_local, mesh=mesh,
+            in_specs=(param_specs, acc_specs, P(), P()) + arg_specs,
+            out_specs=(acc_specs, P()), check_vma=False)
+        return f(params, acc_grads, scale, rng, *args)
+
+    return jax.jit(micro, donate_argnums=(1,),
+                   out_shardings=(sh["acc_grads"],
+                                  NamedSharding(mesh, P())))
+
+
+def build_compressed_apply(engine, update_variance: bool = False):
+    """The compression-stage optimizer step (1-bit momentum allreduce).
+
+    ``update_variance`` keeps the second moment adapting (ZeroOneAdam's
+    pre-var-freeze behaviour, using the communicated momentum); OnebitAdam/
+    OnebitLamb freeze it.
+    """
+    world = validate_onebit_mesh(engine)
+    mesh = engine.mesh
+    sh = engine._state_shardings()
+    hp = engine.optimizer_def.hyperparams
+    b1 = hp["betas"][0]
+    b2 = hp["betas"][1]
+    eps = hp["eps"]
+    wd = hp["weight_decay"]
+    lamb = engine.optimizer_def.name == "onebitlamb"
+    max_c, min_c = hp["max_coeff"], hp["min_coeff"]
+    compute_dtype = engine.compute_dtype
+    fp16_dynamic = engine.fp16_enabled and engine.dynamic_loss_scale
+    fp16_cfg = engine.config.fp16
+
+    spec_of = lambda tree: jax.tree.map(lambda s: s.spec, tree)
+    state_specs = {k: spec_of(v) for k, v in sh.items()}
+
+    def apply_local(state, lr):
+        inv = 1.0 / state["loss_scale"]
+
+        def leaf_step(acc, m, v, p, werr, serr):
+            g = acc[0] * inv                       # local accumulated grad
+            m_local = b1 * m + (1.0 - b1) * g
+            n = m_local.size
+            npad = werr.shape[1]
+            flat = jnp.pad(m_local.reshape(-1), (0, npad - n))
+            avg, new_w, new_s = compressed_allreduce(
+                flat, werr[0], serr[0], DP_AXES)
+            m_avg = avg[:n].reshape(m_local.shape)
+            v_new = b2 * v + (1.0 - b2) * m_avg * m_avg if update_variance \
+                else v
+            stepval = m_avg / (jnp.sqrt(v_new) + eps)
+            if wd > 0.0:
+                stepval = stepval + wd * p
+            if lamb:  # per-layer trust ratio (reference onebit/lamb.py)
+                w_norm = jnp.linalg.norm(p)
+                u_norm = jnp.linalg.norm(stepval)
+                ratio = jnp.where(
+                    (w_norm > 0) & (u_norm > 0),
+                    jnp.clip(w_norm / u_norm, min_c, max_c), 1.0)
+                stepval = ratio * stepval
+            p_new = p - lr * stepval
+            return (p_new, m_avg, v_new, jnp.zeros_like(acc),
+                    new_w[None], new_s[None])
+
+        out = jax.tree.map(leaf_step, state["acc_grads"],
+                           state["opt"]["m"], state["opt"]["v"],
+                           state["master"], state["comm_error_worker"],
+                           state["comm_error_server"])
+        pick = lambda i: jax.tree.map(lambda o: o[i], out,
+                                      is_leaf=lambda x: isinstance(x, tuple))
+        new_master = pick(0)
+        # overflow guard (fp16): keep old state on non-finite update
+        finite = jnp.all(jnp.asarray(
+            [jnp.all(jnp.isfinite(l)) for l in jax.tree.leaves(new_master)]))
+        keep = lambda new, old: jax.tree.map(
+            lambda a, b: jnp.where(finite, a, b), new, old)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(l))
+                             for l in jax.tree.leaves(pick(1))))
+        # dynamic loss scale bookkeeping — same rule as the engine's
+        # standard apply (overflow drains hysteresis, then halves)
+        overflow = ~finite
+        scale, good, hyst = (state["loss_scale"], state["good_steps"],
+                             state["hysteresis"])
+        if fp16_dynamic:
+            window = fp16_cfg.loss_scale_window
+            lower = overflow & (hyst <= 1)
+            grow = ~overflow & (good + 1 >= window)
+            scale = jnp.where(
+                lower, jnp.maximum(scale / 2.0, fp16_cfg.min_loss_scale),
+                jnp.where(grow, scale * 2.0, scale))
+            good = jnp.where(overflow | grow, 0, good + 1)
+            full = jnp.asarray(fp16_cfg.hysteresis, jnp.int32)
+            hyst = jnp.where(overflow, jnp.maximum(hyst - 1, 1),
+                             jnp.where(grow, full, hyst))
+        new_state = dict(state)
+        new_state.update({
+            "step": state["step"] + 1,
+            "opt_step": jnp.where(finite, state["opt_step"] + 1,
+                                  state["opt_step"]),
+            "master": keep(new_master, state["master"]),
+            "params": jax.tree.map(
+                lambda m_: m_.astype(compute_dtype),
+                keep(new_master, state["master"])),
+            "opt": {"m": keep(pick(1), state["opt"]["m"]),
+                    "v": keep(pick(2), state["opt"]["v"])},
+            "acc_grads": pick(3),
+            "comm_error_worker": pick(4),
+            "comm_error_server": pick(5),
+            "loss_scale": scale,
+            "good_steps": good,
+            "hysteresis": hyst,
+        })
+        return new_state, gnorm, overflow
+
+    def apply(state, lr):
+        f = jax.shard_map(apply_local, mesh=mesh,
+                          in_specs=(state_specs, P()),
+                          out_specs=(state_specs, P(), P()),
+                          check_vma=False)
+        return f(state, lr)
+
+    scalar = NamedSharding(mesh, P())
+    return jax.jit(apply, donate_argnums=(0,),
+                   out_shardings=(dict(sh), scalar, scalar))
