@@ -76,6 +76,19 @@ class ElasticityConfig:
                 f"invalid min_gpus/max_gpus: {self.min_gpus}/{self.max_gpus}")
         self.model_parallel_size = int(param_dict.get("model_parallel_size", 1))
         self.num_gpus_per_node = int(param_dict.get("num_gpus_per_node", 1))
+        # Node bounds from the launcher (--min/max_elastic_nodes, exported
+        # by runner.py as DS_ELASTIC_NODE_RANGE) tighten the device range.
+        import os as _os
+
+        node_range = _os.environ.get("DS_ELASTIC_NODE_RANGE")
+        if node_range:
+            lo, hi = (int(v) for v in node_range.split(","))
+            self.min_gpus = max(self.min_gpus, lo * self.num_gpus_per_node)
+            self.max_gpus = min(self.max_gpus, hi * self.num_gpus_per_node)
+            if self.max_gpus < self.min_gpus:
+                raise ElasticityConfigError(
+                    f"launcher node range {node_range} is incompatible with "
+                    f"min_gpus/max_gpus {self.min_gpus}/{self.max_gpus}")
         self.min_time = int(param_dict.get("min_time", 0))
         self.version = float(param_dict.get("version",
                                             LATEST_ELASTICITY_VERSION))

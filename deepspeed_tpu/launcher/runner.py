@@ -32,7 +32,7 @@ from deepspeed_tpu.utils.logging import logger
 
 DLTS_HOSTFILE = "/job/hostfile"
 EXPORT_ENVS = ("PYTHONPATH", "XLA_FLAGS", "JAX_PLATFORMS", "TPU_CHIPS_PER_HOST",
-               "DS_ACCELERATOR")
+               "DS_ACCELERATOR", "DS_ELASTIC_NODE_RANGE")
 
 
 def parse_args(args=None):

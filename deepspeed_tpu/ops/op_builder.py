@@ -69,7 +69,7 @@ _OP_REGISTRY: Dict[str, tuple] = {
     "fused_adam": ("deepspeed_tpu.ops.optimizers", "fused_adam"),
     "fused_lamb": ("deepspeed_tpu.ops.optimizers", "fused_lamb"),
     "fused_lion": ("deepspeed_tpu.ops.optimizers", "fused_lion"),
-    "cpu_adam": ("deepspeed_tpu.ops.optimizers", "fused_adam"),
+    "cpu_adam": ("deepspeed_tpu.ops.cpu_adam", "DeepSpeedCPUAdam"),
     "cpu_adagrad": ("deepspeed_tpu.ops.optimizers", "adagrad"),
     "cpu_lion": ("deepspeed_tpu.ops.optimizers", "fused_lion"),
     "flash_attn": ("deepspeed_tpu.ops.flash_attention", "flash_attention"),

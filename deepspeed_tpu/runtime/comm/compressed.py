@@ -66,10 +66,14 @@ def compressed_allreduce(x: jnp.ndarray, worker_error: jnp.ndarray,
                          f"{world * 8} (pad before calling)")
     chunk = n // world
 
-    # hop 1: worker compress + chunk exchange
+    # hop 1: worker compress + chunk exchange. The error term must use the
+    # sign the WIRE carries (0 encodes as +1 in pack_signs), not jnp.sign's
+    # three-valued version — otherwise exactly-zero elements (padding,
+    # untouched params) accumulate a permanent +scale bias.
+    wire_sign = lambda t: jnp.where(t >= 0, 1.0, -1.0)
     compensated = x.astype(jnp.float32) + worker_error
     w_scale = _scale_of(compensated)
-    new_worker_error = compensated - w_scale * jnp.sign(compensated)
+    new_worker_error = compensated - w_scale * wire_sign(compensated)
 
     packed = pack_signs(compensated).reshape(world, chunk // 8)
     recv = lax.all_to_all(packed, axis_names, split_axis=0, concat_axis=0,
@@ -82,7 +86,7 @@ def compressed_allreduce(x: jnp.ndarray, worker_error: jnp.ndarray,
     # hop 2: server compress + broadcast
     comp_server = chunk_avg + server_error
     s_scale = _scale_of(comp_server)
-    new_server_error = comp_server - s_scale * jnp.sign(comp_server)
+    new_server_error = comp_server - s_scale * wire_sign(comp_server)
     s_packed = pack_signs(comp_server)
     all_packed = lax.all_gather(s_packed, axis_names)      # [W, chunk//8]
     all_scales = lax.all_gather(s_scale, axis_names)       # [W]

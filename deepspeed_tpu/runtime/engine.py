@@ -381,10 +381,13 @@ class DeepSpeedEngine:
         if self._offload_device:
             from deepspeed_tpu.runtime.zero.offload import OffloadPlan
 
-            self._offload_plan = OffloadPlan(params_shapes,
-                                             ratio=self._offload_ratio)
+            self._offload_plan = OffloadPlan(
+                params_shapes, ratio=self._offload_ratio,
+                device=self._offload_device,
+                nvme_path=self.config.zero_config.offload_optimizer.nvme_path)
             log_dist(
-                f"ZeRO-Offload: optimizer state -> host "
+                f"ZeRO-Offload: optimizer state -> "
+                f"{self._offload_device} "
                 f"({self._offload_plan.fraction:.0%} of elements, "
                 f"ratio={self._offload_ratio})", ranks=[0])
         return self._shardings
@@ -689,9 +692,11 @@ class DeepSpeedEngine:
         zero/parameter_offload.py)."""
         plan, sh = self._offload_plan, self._shardings
         self.state["master"] = plan.place(self.state["master"], sh["master"],
-                                          to_host=to_host)
+                                          to_host=to_host,
+                                          swap_prefix="master")
         self.state["opt"] = {
-            k: plan.place(v, sh["opt"][k], to_host=to_host)
+            k: plan.place(v, sh["opt"][k], to_host=to_host,
+                          swap_prefix=f"opt_{k}")
             for k, v in self.state["opt"].items()}
 
     def step(self):
@@ -818,6 +823,7 @@ class DeepSpeedEngine:
             if self.config.wall_clock_breakdown else None)
         self.tput_timer.stop(global_step=True, sync_obj=None)
         self.global_steps += 1
+        self._maybe_profile_flops()
         if self.fp16_enabled and bool(jax.device_get(overflow)):
             self.skipped_steps += 1
             log_dist(
@@ -827,6 +833,16 @@ class DeepSpeedEngine:
                 ranks=[0])
         if self.lr_scheduler is not None:
             self.lr_scheduler.step(self.global_steps)
+        if self.global_steps % self.config.steps_per_print == 0:
+            if self.config.wall_clock_breakdown:
+                self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER],
+                                memory_breakdown=True)
+            if self.monitor.enabled:
+                self.monitor.write_events([
+                    ("Train/lr", self.get_lr()[0], self.global_steps),
+                    ("Train/samples_per_sec",
+                     self.tput_timer.avg_samples_per_sec(),
+                     self.global_steps)])
         return gnorm
 
     def train(self, mode: bool = True):

@@ -271,8 +271,9 @@ def build_compressed_apply(engine, update_variance: bool = False):
             "opt": {"m": keep(pick(1), state["opt"]["m"]),
                     "v": keep(pick(2), state["opt"]["v"])},
             "acc_grads": pick(3),
-            "comm_error_worker": pick(4),
-            "comm_error_server": pick(5),
+            # overflow must not poison error feedback with NaN/inf
+            "comm_error_worker": keep(pick(4), state["comm_error_worker"]),
+            "comm_error_server": keep(pick(5), state["comm_error_server"]),
             "loss_scale": scale,
             "good_steps": good,
             "hysteresis": hyst,

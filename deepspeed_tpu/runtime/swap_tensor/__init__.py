@@ -1,0 +1,9 @@
+"""NVMe tensor swapping for ZeRO-Infinity (reference:
+runtime/swap_tensor/)."""
+
+from deepspeed_tpu.runtime.swap_tensor.partitioned_optimizer_swapper import (
+    AsyncTensorSwapper,
+    PartitionedOptimizerSwapper,
+)
+
+__all__ = ["AsyncTensorSwapper", "PartitionedOptimizerSwapper"]

@@ -39,10 +39,25 @@ class OffloadPlan:
     ZeRO-Offload; 0 < ratio < 1 -> twin-flow).
     """
 
-    def __init__(self, shapes: Any, ratio: float = 1.0):
+    def __init__(self, shapes: Any, ratio: float = 1.0,
+                 device: str = "cpu", nvme_path: Optional[str] = None):
         if not 0.0 <= ratio <= 1.0:
             raise ValueError(f"offload ratio must be in [0,1], got {ratio}")
         self.ratio = ratio
+        self.device = device
+        self._swapper = None
+        if device == "nvme":
+            import jax as _jax
+
+            from deepspeed_tpu.runtime.swap_tensor import (
+                PartitionedOptimizerSwapper)
+
+            if not nvme_path:
+                raise ValueError(
+                    "offload device 'nvme' requires offload_optimizer."
+                    "nvme_path")
+            self._swapper = PartitionedOptimizerSwapper(
+                nvme_path, process_index=_jax.process_index())
         leaves, treedef = jax.tree_util.tree_flatten(shapes)
         sizes = [int(np.prod(l.shape)) for l in leaves]
         total = sum(sizes)
@@ -74,13 +89,17 @@ class OffloadPlan:
         return jax.tree.map(to_host, device_shardings, self.mask)
 
     def place(self, tree: Any, device_shardings: Any,
-              to_host: bool) -> Any:
+              to_host: bool, swap_prefix: str = "state") -> Any:
         """Move masked leaves host<->device (explicit placement boundary).
 
-        ``to_host=True``: masked leaves -> pinned host; others untouched.
+        ``to_host=True``: masked leaves -> pinned host ('cpu') or NVMe swap
+        files exposed as read-only memmaps ('nvme', the ZeRO-Infinity tier:
+        host RAM becomes evictable page cache); others untouched.
         ``to_host=False``: everything -> its device sharding (masked leaves
         stream back to HBM for the optimizer step).
         """
+        if self.device == "nvme" and to_host:
+            return self._swap_out(tree, swap_prefix)
         shardings = self.host_shardings(device_shardings) if to_host \
             else device_shardings
 
@@ -91,6 +110,11 @@ class OffloadPlan:
 
         return jax.tree.map(move, tree, shardings, self.mask)
 
+    def _swap_out(self, tree: Any, prefix: str) -> Any:
+        """NVMe path: masked leaves D2H -> overlapped AIO writes -> memmap
+        (unmasked leaves pass through untouched)."""
+        return self._swapper.swap_out_tree(prefix, tree, mask=self.mask)
+
 
 def validate_offload_config(offload_cfg, zero_stage: int,
                             what: str = "offload_optimizer") -> Optional[str]:
@@ -99,15 +123,14 @@ def validate_offload_config(offload_cfg, zero_stage: int,
     runtime/engine.py _configure_zero_optimizer)."""
     if offload_cfg is None or offload_cfg.device in (None, "none"):
         return None
-    if offload_cfg.device == "nvme":
-        raise NotImplementedError(
-            f"{what}: device='nvme' (ZeRO-Infinity) requires the host AIO "
-            f"swapper — not implemented yet; use device='cpu'")
-    if offload_cfg.device != "cpu":
+    if offload_cfg.device not in ("cpu", "nvme"):
         raise ValueError(
             f"{what}: unknown offload device {offload_cfg.device!r}")
     if zero_stage < 1:
         raise ValueError(
             f"{what} requires ZeRO stage >= 1 (got stage {zero_stage}); "
             f"the reference equally ties offload to a ZeRO optimizer")
-    return "cpu"
+    if offload_cfg.device == "nvme" and not offload_cfg.nvme_path:
+        raise ValueError(
+            f"{what}: device='nvme' (ZeRO-Infinity) requires nvme_path")
+    return offload_cfg.device

@@ -1,0 +1,153 @@
+"""Native host library: cpu_adam numerics, AIO, NVMe swap (reference:
+tests/unit/ops/adam/test_cpu_adam.py, csrc/aio/py_test/, ZeRO-Infinity
+swap tests)."""
+
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from deepspeed_tpu.ops import native
+from deepspeed_tpu.ops.aio import AsyncIOHandle
+from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam, DeepSpeedCPULion
+from deepspeed_tpu.runtime.swap_tensor import PartitionedOptimizerSwapper
+
+
+def test_native_library_builds():
+    """The toolchain is baked into the image; the native path must be real
+    here, not the fallback."""
+    assert native.available(), "g++ build of csrc/host_ops.cpp failed"
+
+
+def test_cpu_adam_matches_fused_adam():
+    """Native host Adam == the device fused_adam tree update (reference
+    pattern: CUDA kernel vs torch numerics)."""
+    from deepspeed_tpu.ops.optimizers import fused_adam
+
+    rng = np.random.default_rng(0)
+    params_np = {"a": rng.normal(size=(64, 32)).astype(np.float32),
+                 "b": rng.normal(size=(128,)).astype(np.float32)}
+    grads_np = {"a": rng.normal(size=(64, 32)).astype(np.float32),
+                "b": rng.normal(size=(128,)).astype(np.float32)}
+
+    opt = fused_adam(lr=1e-2, weight_decay=0.01)
+    state = opt.init(jax.tree.map(jnp.asarray, params_np))
+    master = jax.tree.map(jnp.asarray, params_np)
+    for step in range(1, 4):
+        master, state = opt.update(jax.tree.map(jnp.asarray, grads_np),
+                                   state, master, 1e-2,
+                                   jnp.asarray(step, jnp.int32))
+
+    cpu = DeepSpeedCPUAdam(lr=1e-2, weight_decay=0.01)
+    host = jax.tree.map(np.copy, params_np)
+    for _ in range(3):
+        cpu.step(host, grads_np)
+
+    for k in params_np:
+        np.testing.assert_allclose(host[k], np.asarray(master[k]),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_cpu_lion_runs():
+    rng = np.random.default_rng(1)
+    p = {"w": rng.normal(size=(32, 32)).astype(np.float32)}
+    g = {"w": rng.normal(size=(32, 32)).astype(np.float32)}
+    before = p["w"].copy()
+    DeepSpeedCPULion(lr=1e-3).step(p, g)
+    delta = np.abs(p["w"] - before)
+    assert delta.max() > 0
+    assert delta.max() <= 1e-3 + 1e-7  # sign update bounded by lr
+
+
+def test_aio_roundtrip(tmp_path):
+    h = AsyncIOHandle(num_threads=4, block_size=4096)
+    data = np.random.default_rng(2).integers(
+        0, 255, size=(1 << 16,), dtype=np.uint8)
+    path = str(tmp_path / "blob.bin")
+    req = h.async_pwrite(data, path)
+    h.wait(req)
+    out = np.zeros_like(data)
+    req = h.async_pread(out, path)
+    h.wait(req)
+    assert (out == data).all()
+    h.close()
+
+
+def test_aio_many_concurrent_requests(tmp_path):
+    h = AsyncIOHandle(num_threads=4, block_size=1024)
+    rng = np.random.default_rng(3)
+    blobs = [rng.integers(0, 255, size=(8192,), dtype=np.uint8)
+             for _ in range(16)]
+    reqs = [h.async_pwrite(b, str(tmp_path / f"f{i}.bin"))
+            for i, b in enumerate(blobs)]
+    h.wait()  # wait_all
+    outs = [np.zeros_like(b) for b in blobs]
+    for i, o in enumerate(outs):
+        h.wait(h.async_pread(o, str(tmp_path / f"f{i}.bin")))
+    for o, b in zip(outs, blobs):
+        assert (o == b).all()
+    h.close()
+
+
+def test_aio_missing_file_raises(tmp_path):
+    h = AsyncIOHandle(num_threads=2)
+    buf = np.zeros(128, dtype=np.uint8)
+    with pytest.raises(IOError):
+        h.wait(h.async_pread(buf, str(tmp_path / "nope.bin")))
+    h.close()
+
+
+def test_optimizer_swapper_roundtrip(tmp_path):
+    sw = PartitionedOptimizerSwapper(str(tmp_path))
+    rng = np.random.default_rng(4)
+    tree = {"layer_0": {"kernel": rng.normal(size=(32, 32)).astype(np.float32),
+                        "bias": rng.normal(size=(32,)).astype(np.float32)}}
+    mapped = sw.swap_out_tree("m", tree)
+    # memmap views match the written data
+    np.testing.assert_array_equal(np.asarray(mapped["layer_0"]["kernel"]),
+                                  tree["layer_0"]["kernel"])
+    back = sw.swap_in_tree("m", tree)
+    np.testing.assert_array_equal(back["layer_0"]["bias"],
+                                  tree["layer_0"]["bias"])
+
+
+def test_engine_nvme_offload_trains(tmp_path):
+    """ZeRO-Infinity: stage-1 + nvme offload — optimizer state lives in
+    swap files between steps (memmap leaves), loss trajectory matches cpu
+    offload."""
+    import deepspeed_tpu
+    from deepspeed_tpu.parallel import groups
+    from simple_model import SimpleModel, train_steps
+
+    def cfg(device):
+        c = {"train_micro_batch_size_per_gpu": 2,
+             "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+             "zero_optimization": {
+                 "stage": 1,
+                 "offload_optimizer": {"device": device,
+                                       "nvme_path": str(tmp_path)}}}
+        return c
+
+    m = SimpleModel(hidden_dim=16)
+    e_cpu, _, _, _ = deepspeed_tpu.initialize(
+        model=(m.init, m.apply), config=cfg("cpu"))
+    l_cpu = train_steps(e_cpu, steps=6, batch=16, hidden_dim=16)
+
+    groups.reset()
+    e_nvme, _, _, _ = deepspeed_tpu.initialize(
+        model=(m.init, m.apply), config=cfg("nvme"))
+    l_nvme = train_steps(e_nvme, steps=6, batch=16, hidden_dim=16)
+
+    np.testing.assert_allclose(l_nvme, l_cpu, rtol=1e-5)
+    # between steps the offloaded master leaves are file-backed memmaps
+    leaf = jax.tree.leaves(e_nvme.state["master"])[0]
+    offloaded = [l for l in jax.tree.leaves(e_nvme.state["master"])
+                 if isinstance(l, np.memmap)]
+    assert offloaded, "no master leaf is NVMe-backed"
+    swap_files = list(Path(tmp_path).rglob("*.swp"))
+    assert swap_files, "no swap files written"

@@ -101,8 +101,10 @@ def test_offload_plan_ratio_bounds():
         OffloadPlan(shapes, 1.5)
 
 
-def test_nvme_offload_fails_loudly():
-    with pytest.raises(NotImplementedError, match="nvme"):
+def test_nvme_offload_requires_path():
+    # nvme offload is implemented (see test_native_ops.py); without a
+    # swap directory it must still fail loudly
+    with pytest.raises(ValueError, match="nvme_path"):
         _engine(_config(offload={"device": "nvme"}))
 
 
