@@ -1,0 +1,55 @@
+"""Evoformer attention — DS4Science (reference:
+csrc/deepspeed4science/evoformer_attn/ CUTLASS fused MHA with broadcast
+pair biases, python surface deepspeed/ops/deepspeed4science/evoformer_attn.py
+``DS4Sci_EvoformerAttention``; built by op_builder/evoformer_attn.py).
+
+The kernel fuses QK^T + up to two broadcast biases (MSA mask bias and the
+pair-representation bias) + softmax + PV. On TPU the same fusion is one
+XLA dot-softmax-dot chain in fp32; shapes follow the reference:
+Q/K/V [*, seq, heads, dim], biases broadcastable to
+[*, heads, seq_q, seq_k].
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["DS4Sci_EvoformerAttention", "EvoformerAttnBuilder"]
+
+
+def DS4Sci_EvoformerAttention(Q: jnp.ndarray, K: jnp.ndarray,
+                              V: jnp.ndarray,
+                              biases: Optional[List[jnp.ndarray]] = None,
+                              ) -> jnp.ndarray:
+    """Fused evoformer MHA (reference evoformer_attn.py API).
+
+    Q/K/V: [..., seq, heads, head_dim]; each bias broadcastable to
+    [..., heads, seq_q, seq_k] (the reference takes [mask_bias,
+    pair_bias]). Returns attention output in Q's layout and dtype.
+    """
+    *lead, sq, h, d = Q.shape
+    scale = 1.0 / float(np.sqrt(d))
+    q = jnp.moveaxis(Q.astype(jnp.float32), -2, -3)   # [..., h, sq, d]
+    k = jnp.moveaxis(K.astype(jnp.float32), -2, -3)
+    v = jnp.moveaxis(V.astype(jnp.float32), -2, -3)
+    scores = jnp.einsum("...hqd,...hkd->...hqk", q, k) * scale
+    for bias in biases or []:
+        scores = scores + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("...hqk,...hkd->...hqd", probs, v)
+    return jnp.moveaxis(out, -3, -2).astype(Q.dtype)
+
+
+class EvoformerAttnBuilder:
+    NAME = "evoformer_attn"
+
+    def load(self):
+        import deepspeed_tpu.ops.evoformer_attn as m
+        return m
+
+    def is_compatible(self) -> bool:
+        return True

@@ -1,0 +1,44 @@
+"""Ragged-batching ops surface (reference: inference/v2/kernels/ragged_ops/
+— atom_builder, blocked_flash, logits_gather, linear_blocked_kv_rotary —
+built by op_builder/ragged_ops.py / ragged_utils.py).
+
+The TPU implementations live with the FastGen engine
+(deepspeed_tpu/inference/v2/ragged/): static-shape token-budget batching
+makes most CUDA ragged kernels into plain gathers. This module re-exports
+them under the op-builder name and adds the standalone gather op.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import (  # noqa: F401
+    BlockedAllocator,
+)
+from deepspeed_tpu.inference.v2.ragged.kv_cache import (  # noqa: F401
+    BlockedKVCache,
+)
+from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import (  # noqa: F401
+    RaggedBatchWrapper,
+)
+
+__all__ = ["BlockedAllocator", "BlockedKVCache", "RaggedBatchWrapper",
+           "logits_gather", "RaggedOpsBuilder"]
+
+
+def logits_gather(logits: jnp.ndarray, last_token_idx: jnp.ndarray
+                  ) -> jnp.ndarray:
+    """Keep only each sequence's final-token logits (reference
+    ragged_ops/logits_gather): logits [tokens, vocab], idx [seqs]."""
+    return jnp.take(logits, last_token_idx.astype(jnp.int32), axis=0)
+
+
+class RaggedOpsBuilder:
+    NAME = "ragged_ops"
+
+    def load(self):
+        import deepspeed_tpu.ops.ragged as m
+        return m
+
+    def is_compatible(self) -> bool:
+        return True

@@ -1,0 +1,71 @@
+"""Random layer token dropping kernels (reference: csrc/random_ltd/
+token_sort.cu, gather_scatter.cu, slice_attn_masks.cu; python surface
+deepspeed/ops/random_ltd + runtime/data_pipeline/data_routing/; built by
+op_builder/random_ltd.py).
+
+Random-LTD trains middle layers on a random subset of tokens per step:
+sample-and-sort indices, gather the kept tokens before the layer, scatter
+the layer output back over the full hidden states. On TPU these are
+static-shape gathers XLA vectorises; sampling uses an argsort of uniforms
+(an unbiased choice-without-replacement, the role of token_sort.cu).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_token_indices", "gather_tokens", "scatter_tokens",
+           "slice_attention_mask", "RandomLTDBuilder"]
+
+
+def sample_token_indices(rng: jax.Array, batch: int, seq_len: int,
+                         keep: int) -> jnp.ndarray:
+    """[batch, keep] sorted kept-token indices (token_sort.cu role)."""
+    if not 0 < keep <= seq_len:
+        raise ValueError(f"keep {keep} outside (0, {seq_len}]")
+    noise = jax.random.uniform(rng, (batch, seq_len))
+    picked = jnp.argsort(noise, axis=1)[:, :keep]
+    return jnp.sort(picked, axis=1)
+
+
+def gather_tokens(x: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """x [batch, seq, ...] -> [batch, keep, ...] (gather_scatter.cu)."""
+    idx = indices.reshape(indices.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(
+        x, idx.astype(jnp.int32), axis=1)
+
+
+def scatter_tokens(full: jnp.ndarray, sub: jnp.ndarray,
+                   indices: jnp.ndarray) -> jnp.ndarray:
+    """Write the processed subset back into the full sequence."""
+    b = full.shape[0]
+    batch_idx = jnp.arange(b)[:, None]
+    return full.at[batch_idx, indices].set(sub)
+
+
+def slice_attention_mask(mask: jnp.ndarray, indices: jnp.ndarray
+                         ) -> jnp.ndarray:
+    """[batch, ..., seq, seq] mask -> kept rows/cols
+    (slice_attn_masks.cu)."""
+    m = jnp.take_along_axis(
+        mask, indices.reshape(indices.shape[0],
+                              *(1,) * (mask.ndim - 3),
+                              indices.shape[1], 1).astype(jnp.int32),
+        axis=-2)
+    return jnp.take_along_axis(
+        m, indices.reshape(indices.shape[0], *(1,) * (mask.ndim - 3), 1,
+                           indices.shape[1]).astype(jnp.int32), axis=-1)
+
+
+class RandomLTDBuilder:
+    NAME = "random_ltd"
+
+    def load(self):
+        import deepspeed_tpu.ops.random_ltd as m
+        return m
+
+    def is_compatible(self) -> bool:
+        return True

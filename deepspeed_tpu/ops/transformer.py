@@ -1,0 +1,134 @@
+"""Fused transformer building-block ops (role of the reference's
+csrc/transformer/*.cu training kernels and
+csrc/transformer/inference/csrc/*.cu — gelu/relu bias fusions, layer_norm,
+rms_norm, rotary, softmax, residual_add — built by op_builder/transformer.py
+and transformer_inference.py).
+
+On TPU each of these is a short jnp composition XLA fuses into the
+surrounding matmuls (the reason the reference hand-wrote them on CUDA);
+keeping them as named ops preserves the reference's kernel API surface and
+gives a single place to swap in Pallas variants if a fusion ever misses.
+Computation is fp32-accumulated and cast back, matching the reference
+kernels' numerics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "layer_norm", "rms_norm", "residual_add", "bias_add", "bias_gelu",
+    "bias_relu", "gated_activation", "apply_rotary_pos_emb",
+    "scaled_masked_softmax", "TransformerBuilder",
+]
+
+
+def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
+               eps: float = 1e-5) -> jnp.ndarray:
+    """csrc/transformer/inference layer_norm.cu ``ds_layer_norm``."""
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = jnp.square(xf - mean).mean(axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (out * weight.astype(jnp.float32) +
+            bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray,
+             eps: float = 1e-6) -> jnp.ndarray:
+    """csrc/transformer/inference rms_norm.cu ``ds_rms_norm``."""
+    xf = x.astype(jnp.float32)
+    var = jnp.square(xf).mean(axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) *
+            weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def residual_add(hidden: jnp.ndarray, residual: jnp.ndarray,
+                 attn_output: Optional[jnp.ndarray] = None,
+                 attn_bias: Optional[jnp.ndarray] = None,
+                 final_bias: Optional[jnp.ndarray] = None,
+                 mp_size: int = 1) -> jnp.ndarray:
+    """pt_binding.cpp ``residual_add_bias``: hidden + residual (+ biases,
+    divided by mp_size when the TP all-reduce sums them)."""
+    out = hidden.astype(jnp.float32) + residual.astype(jnp.float32)
+    for extra in (attn_output, attn_bias, final_bias):
+        if extra is not None:
+            out = out + extra.astype(jnp.float32) / float(mp_size)
+    return out.astype(hidden.dtype)
+
+
+def bias_add(x: jnp.ndarray, bias: jnp.ndarray) -> jnp.ndarray:
+    return (x.astype(jnp.float32) +
+            bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def bias_gelu(x: jnp.ndarray, bias: Optional[jnp.ndarray] = None
+              ) -> jnp.ndarray:
+    """gelu.cu ``fused_bias_gelu`` (tanh approximation, as the kernel)."""
+    xf = x.astype(jnp.float32)
+    if bias is not None:
+        xf = xf + bias.astype(jnp.float32)
+    return jax.nn.gelu(xf, approximate=True).astype(x.dtype)
+
+
+def bias_relu(x: jnp.ndarray, bias: Optional[jnp.ndarray] = None
+              ) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    if bias is not None:
+        xf = xf + bias.astype(jnp.float32)
+    return jnp.maximum(xf, 0.0).astype(x.dtype)
+
+
+def gated_activation(x: jnp.ndarray, act: str = "silu") -> jnp.ndarray:
+    """gated_activations kernel (inference v2 core_ops): input is
+    [..., 2*d] interleaved as (gate, up); returns act(gate) * up."""
+    gate, up = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    fn = {"silu": jax.nn.silu, "gelu": jax.nn.gelu,
+          "relu": lambda t: jnp.maximum(t, 0.0)}[act]
+    return (fn(gate) * up).astype(x.dtype)
+
+
+def _rope_freqs(dim: int, theta: float, positions: jnp.ndarray):
+    inv = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    ang = positions.astype(jnp.float32)[..., None] * inv
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rotary_pos_emb(x: jnp.ndarray, positions: jnp.ndarray,
+                         theta: float = 10000.0) -> jnp.ndarray:
+    """rotary kernel (csrc/transformer/inference apply_rotary_pos_emb):
+    x [..., seq, heads, head_dim], positions [..., seq]."""
+    cos, sin = _rope_freqs(x.shape[-1], theta, positions)
+    cos = cos[..., :, None, :]
+    sin = sin[..., :, None, :]
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def scaled_masked_softmax(scores: jnp.ndarray,
+                          mask: Optional[jnp.ndarray] = None,
+                          scale: float = 1.0) -> jnp.ndarray:
+    """softmax.cu ``attn_softmax`` — fp32 softmax with additive mask."""
+    s = scores.astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30) if mask.dtype == jnp.bool_ \
+            else s + mask.astype(jnp.float32)
+    return jax.nn.softmax(s, axis=-1).astype(scores.dtype)
+
+
+class TransformerBuilder:
+    """op_builder surface (reference op_builder/transformer.py)."""
+
+    NAME = "transformer"
+
+    def load(self):
+        import deepspeed_tpu.ops.transformer as m
+        return m
+
+    def is_compatible(self) -> bool:
+        return True

@@ -1,0 +1,6 @@
+"""Activation checkpointing (reference:
+runtime/activation_checkpointing/)."""
+
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+__all__ = ["checkpointing"]

@@ -1,0 +1,108 @@
+"""Activation checkpointing API (reference:
+runtime/activation_checkpointing/checkpointing.py — ``configure:1070``,
+``checkpoint:989``, ``CheckpointFunction:484``, partitioned activations,
+CPU checkpointing, RNG state tracking ``CudaRNGStatesTracker:122``).
+
+TPU mapping — each reference knob becomes a ``jax.checkpoint`` (remat)
+policy instead of hook machinery:
+
+* plain checkpointing     → remat with ``nothing_saveable`` (recompute all)
+* ``partition_activations``→ saved residuals carry their sharded layout —
+  under GSPMD activations are already sharded over the mesh, so remat
+  simply does not gather them (the reference must scatter/gather by hand)
+* ``cpu_checkpointing``   → remat policy offloading saved residuals to
+  pinned host memory (``save_and_offload_only_these_names`` /
+  ``offload_dot_with_no_batch_dims`` when available in the JAX build)
+* RNG tracking            → free: JAX threading of explicit PRNG keys makes
+  dropout deterministic under recomputation by construction.
+
+Models call ``checkpoint(fn, *args)`` exactly like the reference; the
+engine's ``activation_checkpointing`` config block feeds ``configure``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+_config = None
+_policy = None
+_configured = False
+
+
+def _resolve_policy(cfg) -> Optional[Callable]:
+    if cfg is None:
+        return None
+    if getattr(cfg, "cpu_checkpointing", False):
+        pol = getattr(jax.checkpoint_policies,
+                      "offload_dot_with_no_batch_dims", None)
+        if pol is not None:
+            try:
+                return pol("device", "pinned_host")
+            except TypeError:
+                pass
+        logger.warning(
+            "cpu_checkpointing: this JAX build has no offload remat "
+            "policy; falling back to full recomputation")
+    return jax.checkpoint_policies.nothing_saveable
+
+
+def configure(mpu_=None, deepspeed_config=None,
+              partition_activations: Optional[bool] = None,
+              contiguous_checkpointing: Optional[bool] = None,
+              checkpoint_in_cpu: Optional[bool] = None,
+              synchronize: Optional[bool] = None,
+              profile: Optional[bool] = None) -> None:
+    """reference ``configure:1070`` — accepts either the engine config's
+    activation_checkpointing block or explicit flags."""
+    global _config, _policy, _configured
+    cfg = None
+    if deepspeed_config is not None:
+        cfg = getattr(deepspeed_config, "activation_checkpointing",
+                      deepspeed_config)
+    if cfg is None:
+        class _Flags:  # explicit-flag form
+            pass
+
+        cfg = _Flags()
+        cfg.partition_activations = bool(partition_activations)
+        cfg.cpu_checkpointing = bool(checkpoint_in_cpu)
+        cfg.contiguous_memory_optimization = bool(contiguous_checkpointing)
+    _config = cfg
+    _policy = _resolve_policy(cfg)
+    _configured = True
+
+
+def is_configured() -> bool:
+    return _configured
+
+
+def checkpoint(function: Callable, *args) -> Any:
+    """Rematerialised call (reference ``checkpoint:989`` /
+    ``CheckpointFunction``): activations of ``function`` are recomputed in
+    the backward pass instead of stored."""
+    policy = _policy if _configured else \
+        jax.checkpoint_policies.nothing_saveable
+    return jax.checkpoint(function, policy=policy)(*args)
+
+
+def non_reentrant_checkpoint(function: Callable, *args) -> Any:
+    """reference ``non_reentrant_checkpoint:724`` — identical under JAX
+    (remat has no reentrancy distinction; kept for API parity)."""
+    return checkpoint(function, *args)
+
+
+def model_parallel_cuda_manual_seed(seed: int) -> None:
+    """reference RNG tracker seeding — a no-op under JAX's explicit PRNG
+    keys (kept for API parity)."""
+    del seed
+
+
+def reset() -> None:
+    global _config, _policy, _configured
+    _config = None
+    _policy = None
+    _configured = False

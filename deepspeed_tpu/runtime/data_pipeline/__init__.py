@@ -1,0 +1,12 @@
+"""Data-efficiency pipeline (reference: runtime/data_pipeline/ —
+curriculum learning + random-LTD data routing)."""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+    RandomLTDScheduler,
+    apply_random_ltd,
+)
+
+__all__ = ["CurriculumScheduler", "RandomLTDScheduler", "apply_random_ltd"]

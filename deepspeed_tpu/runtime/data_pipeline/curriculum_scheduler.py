@@ -1,0 +1,109 @@
+"""Curriculum learning scheduler (reference:
+runtime/data_pipeline/curriculum_scheduler.py ``CurriculumScheduler`` —
+fixed_linear / fixed_root / fixed_discrete / custom schedules over a
+difficulty metric, typically sequence length).
+
+Math matches the reference: fixed_root difficulty at step t is
+floor((t/T)^(1/r) * (max-min) + min) rounded DOWN to a multiple of
+``difficulty_step`` and clipped to max; fixed_linear is root degree 1;
+fixed_discrete walks a (difficulty[], max_step[]) staircase.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        for key in ("curriculum_type", "min_difficulty", "max_difficulty",
+                    "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum config requires '{key}'")
+        self.curriculum_type = config["curriculum_type"]
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.schedule_type = config["schedule_type"]
+        self.schedule: Dict[str, Any] = dict(
+            config.get("schedule_config", config.get("schedule", {})))
+        self.current_difficulty = self.min_difficulty
+        self.first_step = True
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+
+        if self.schedule_type in ("fixed_linear", "fixed_root"):
+            for key in ("total_curriculum_step", "difficulty_step"):
+                if key not in self.schedule:
+                    raise ValueError(
+                        f"{self.schedule_type} schedule requires '{key}'")
+            if self.schedule_type == "fixed_root" and \
+                    "root_degree" not in self.schedule:
+                raise ValueError("fixed_root schedule requires 'root_degree'")
+            if self.schedule["difficulty_step"] % 8 != 0:
+                logger.warning(
+                    "curriculum difficulty_step not a multiple of 8: seqlen "
+                    "metrics won't tile the MXU/Tensor Cores efficiently")
+        elif self.schedule_type == "fixed_discrete":
+            diff = self.schedule.get("difficulty")
+            steps = self.schedule.get("max_step")
+            if not diff or steps is None or len(steps) != len(diff) - 1:
+                raise ValueError(
+                    "fixed_discrete needs 'difficulty' (n) and 'max_step' "
+                    "(n-1) lists")
+        elif self.schedule_type != "custom":
+            raise ValueError(
+                f"unsupported curriculum schedule {self.schedule_type!r}")
+
+    # -------------------------------------------------------------- #
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self.custom_get_difficulty = fn
+
+    def _root_difficulty(self, step: int, degree: float) -> int:
+        frac = (float(step) / self.schedule["total_curriculum_step"]) ** \
+            (1.0 / degree)
+        d = math.floor(frac * (self.max_difficulty - self.min_difficulty) +
+                       self.min_difficulty)
+        d -= d % self.schedule["difficulty_step"]
+        return min(d, self.max_difficulty)
+
+    def _discrete_difficulty(self, step: int) -> int:
+        diffs = self.schedule["difficulty"]
+        max_steps = self.schedule["max_step"]
+        for d, bound in zip(diffs, max_steps):
+            if step <= bound:
+                return d
+        return diffs[-1]
+
+    def get_difficulty(self, global_steps: int) -> int:
+        if self.schedule_type == "fixed_linear":
+            return self._root_difficulty(global_steps, 1.0)
+        if self.schedule_type == "fixed_root":
+            return self._root_difficulty(global_steps,
+                                         self.schedule["root_degree"])
+        if self.schedule_type == "fixed_discrete":
+            return self._discrete_difficulty(global_steps)
+        if self.custom_get_difficulty is None:
+            raise RuntimeError("custom schedule needs "
+                               "set_custom_get_difficulty()")
+        return self.custom_get_difficulty(global_steps)
+
+    def update_difficulty(self, global_steps: int) -> int:
+        if self.current_difficulty < self.max_difficulty:
+            self.current_difficulty = max(self.get_difficulty(global_steps),
+                                          self.min_difficulty)
+        return self.current_difficulty
+
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    def set_current_difficulty(self, difficulty: int) -> None:
+        self.current_difficulty = difficulty
+
+    # checkpointable state (reference get/set_state)
+    def get_state(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.current_difficulty = state["current_difficulty"]

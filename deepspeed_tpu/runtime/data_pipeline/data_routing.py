@@ -1,0 +1,88 @@
+"""Random-LTD data routing (reference:
+runtime/data_pipeline/data_routing/scheduler.py ``RandomLTDScheduler`` +
+basic_layer.py ``RandomLayerTokenDrop``; kernels ops/random_ltd).
+
+The scheduler grows the number of kept ("reserved") tokens per middle
+layer from ``min_value`` to ``max_value`` over ``total_layer_token_step``
+steps in ``step_size`` increments; :func:`apply_random_ltd` is the
+layer-wrapper: gather a random token subset, run the layer, scatter the
+outputs back (identity for the kept tokens, passthrough for the rest).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.random_ltd import (
+    gather_tokens,
+    sample_token_indices,
+    scatter_tokens,
+    slice_attention_mask,
+)
+
+
+class RandomLTDScheduler:
+    """Reserved-token-count schedule (reference scheduler.py)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        sched = config.get("random_ltd_schedule", config)
+        self.min_value = int(sched.get("min_value", 128))
+        self.max_value = int(sched.get("max_value", 512))
+        cfg2 = sched.get("schedule_config", sched)
+        self.step_size = int(cfg2.get("seq_per_step",
+                                      cfg2.get("step_size", 16)))
+        self.total_steps = int(cfg2.get("total_layer_token_step",
+                                        cfg2.get("total_steps", 1000)))
+        self.schedule_type = sched.get("schedule_type", "fixed_linear")
+        if self.schedule_type != "fixed_linear":
+            raise ValueError(
+                f"random-ltd supports fixed_linear (got "
+                f"{self.schedule_type!r})")
+        self.current_seq = self.min_value
+
+    def get_current_seq(self) -> int:
+        return self.current_seq
+
+    def update_seq(self, global_steps: int) -> int:
+        frac = min(1.0, float(global_steps) / max(1, self.total_steps))
+        seq = int(self.min_value +
+                  frac * (self.max_value - self.min_value))
+        seq -= seq % self.step_size
+        self.current_seq = max(self.min_value,
+                               min(seq, self.max_value))
+        return self.current_seq
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"current_seq": self.current_seq}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.current_seq = state["current_seq"]
+
+
+def apply_random_ltd(rng: jax.Array, hidden: jnp.ndarray,
+                     layer_fn: Callable[..., jnp.ndarray],
+                     reserved_length: int,
+                     attention_mask: Optional[jnp.ndarray] = None,
+                     ) -> jnp.ndarray:
+    """Run ``layer_fn`` on a random token subset (reference
+    RandomLayerTokenDrop.forward): hidden [batch, seq, d] ->
+    same shape, non-selected tokens passed through unchanged.
+
+    ``reserved_length`` must be static (jit recompiles when the scheduler
+    advances to a new value — a handful of compilations across training).
+    """
+    b, s = hidden.shape[:2]
+    if reserved_length >= s:
+        return layer_fn(hidden, attention_mask) if attention_mask is not None \
+            else layer_fn(hidden)
+    idx = sample_token_indices(rng, b, s, reserved_length)
+    sub = gather_tokens(hidden, idx)
+    if attention_mask is not None:
+        sub_mask = slice_attention_mask(attention_mask, idx)
+        out_sub = layer_fn(sub, sub_mask)
+    else:
+        out_sub = layer_fn(sub)
+    return scatter_tokens(hidden, out_sub, idx)

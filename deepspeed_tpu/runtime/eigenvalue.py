@@ -1,0 +1,74 @@
+"""Hessian max-eigenvalue estimation by power iteration (reference:
+runtime/eigenvalue.py ``Eigenvalue`` — feeds the compression scheduler's
+quantization-period decisions).
+
+JAX makes the reference's manual double-backward loop a one-liner:
+the Hessian-vector product is ``jvp(grad(loss))`` and the whole power
+iteration jits into a single device program (``lax`` loop with a relative
+-tolerance early exit), where the reference pays a full autograd graph per
+iteration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Eigenvalue:
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1,
+                 layer_name: str = "", layer_num: int = 0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+
+    def nan_to_zero(self, tree: Any) -> Any:
+        return jax.tree.map(jnp.nan_to_num, tree)
+
+    def normalize(self, tree: Any) -> Any:
+        sq = sum(jnp.sum(jnp.square(l)) for l in jax.tree.leaves(tree))
+        inv = jax.lax.rsqrt(sq + self.stability)
+        return jax.tree.map(lambda l: l * inv, tree)
+
+    def compute_eigenvalue(self, loss_fn: Callable[[Any], jnp.ndarray],
+                           params: Any, rng: jax.Array
+                           ) -> Tuple[jnp.ndarray, Any]:
+        """Largest |eigenvalue| of the loss Hessian at ``params`` and the
+        corresponding eigenvector (as a params-shaped tree)."""
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(v):
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        keys = jax.random.split(rng, len(leaves))
+        v0 = self.normalize(jax.tree_util.tree_unflatten(
+            treedef,
+            [jax.random.normal(k, l.shape, jnp.float32)
+             for k, l in zip(keys, leaves)]))
+
+        def body(carry):
+            i, v, prev_ev, _done = carry
+            hv = self.nan_to_zero(hvp(v))
+            ev = sum(jnp.sum(a * b) for a, b in
+                     zip(jax.tree.leaves(v), jax.tree.leaves(hv)))
+            done = jnp.abs(ev - prev_ev) / (jnp.abs(prev_ev) +
+                                            self.stability) < self.tol
+            return i + 1, self.normalize(hv), ev, done
+
+        def cond(carry):
+            i, _v, _ev, done = carry
+            return (i < self.max_iter) & ~done
+
+        _, v, ev, _ = jax.lax.while_loop(
+            cond, body, (0, v0, jnp.asarray(0.0, jnp.float32),
+                         jnp.asarray(False)))
+        return ev, v

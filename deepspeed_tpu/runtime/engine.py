@@ -296,6 +296,46 @@ class DeepSpeedEngine:
 
         self.monitor = MonitorMaster(self.config)
 
+        # data efficiency: curriculum, random-LTD, progressive layer drop
+        # (reference runtime/data_pipeline/, progressive_layer_drop.py)
+        self.curriculum_scheduler = None
+        self.random_ltd_scheduler = None
+        self.progressive_layer_drop = None
+        cl_cfg = self.config.curriculum_learning or {}
+        de = self.config.data_efficiency or {}
+        if not cl_cfg.get("enabled", False):
+            cl_cfg = de.get("data_sampling", {}).get("curriculum_learning",
+                                                     {})
+            # reference data-efficiency format nests the schedule under
+            # curriculum_metrics.<metric_name>
+            metrics = cl_cfg.get("curriculum_metrics")
+            if cl_cfg.get("enabled", False) and metrics:
+                name, mcfg = next(iter(metrics.items()))
+                cl_cfg = {"enabled": True, "curriculum_type": name, **mcfg}
+        if cl_cfg.get("enabled", False):
+            from deepspeed_tpu.runtime.data_pipeline import (
+                CurriculumScheduler)
+
+            self.curriculum_scheduler = CurriculumScheduler(cl_cfg)
+        ltd_cfg = de.get("data_routing", {}).get("random_ltd", {})
+        if ltd_cfg.get("enabled", False):
+            from deepspeed_tpu.runtime.data_pipeline import RandomLTDScheduler
+
+            self.random_ltd_scheduler = RandomLTDScheduler(ltd_cfg)
+        pld_cfg = self.config.progressive_layer_drop or {}
+        if pld_cfg.get("enabled", False):
+            from deepspeed_tpu.runtime.progressive_layer_drop import (
+                ProgressiveLayerDrop)
+
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_cfg.get("theta", 0.5),
+                gamma=pld_cfg.get("gamma", 0.001))
+        if "activation_checkpointing" in self.config._param_dict:
+            from deepspeed_tpu.runtime.activation_checkpointing import (
+                checkpointing)
+
+            checkpointing.configure(deepspeed_config=self.config)
+
         # timers / throughput / flops profiler (reference utils/timer.py:43,
         # runtime/engine.py:140 EngineTimers, profiling/flops_profiler) -----
         self.wall_clock_breakdown = lambda: self.config.wall_clock_breakdown
@@ -741,6 +781,7 @@ class DeepSpeedEngine:
             global_step=True,
             sync_obj=self.state["loss_scale"] if tput_sync else None)
         self.global_steps += 1
+        self._update_data_efficiency()
         self._maybe_profile_flops()
         if self.fp16_enabled:
             # overflow is tiny; fetching it keeps skipped_steps accurate
@@ -793,6 +834,31 @@ class DeepSpeedEngine:
                                  detailed=fp.detailed,
                                  output_file=fp.output_file)
 
+    def _update_data_efficiency(self):
+        """Advance curriculum/random-LTD/PLD schedules to the new global
+        step (reference engine step hooks)."""
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
+        if self.random_ltd_scheduler is not None:
+            self.random_ltd_scheduler.update_seq(self.global_steps)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+
+    def get_data_difficulty(self) -> Optional[int]:
+        if self.curriculum_scheduler is None:
+            return None
+        return self.curriculum_scheduler.get_current_difficulty()
+
+    def get_random_ltd_seq(self) -> Optional[int]:
+        if self.random_ltd_scheduler is None:
+            return None
+        return self.random_ltd_scheduler.get_current_seq()
+
+    def get_pld_theta(self) -> float:
+        if self.progressive_layer_drop is None:
+            return 1.0
+        return self.progressive_layer_drop.get_theta()
+
     def _onebit_compression_stage(self) -> bool:
         return self._onebit and self.global_steps >= \
             int(self.optimizer_def.hyperparams.get("freeze_step", 0))
@@ -823,6 +889,7 @@ class DeepSpeedEngine:
             if self.config.wall_clock_breakdown else None)
         self.tput_timer.stop(global_step=True, sync_obj=None)
         self.global_steps += 1
+        self._update_data_efficiency()
         self._maybe_profile_flops()
         if self.fp16_enabled and bool(jax.device_get(overflow)):
             self.skipped_steps += 1
@@ -929,4 +996,8 @@ class DeepSpeedEngine:
             if (load_lr_scheduler_states and self.lr_scheduler is not None
                     and "lr_scheduler" in client_state):
                 self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+            # data-efficiency schedules are pure functions of global_steps:
+            # re-derive them so the first post-resume batch sees the right
+            # difficulty/seq/theta
+            self._update_data_efficiency()
         return path, client_state

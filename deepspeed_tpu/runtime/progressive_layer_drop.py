@@ -1,0 +1,35 @@
+"""Progressive Layer Dropping (reference:
+runtime/progressive_layer_drop.py — PLD, arXiv:2010.13369).
+
+Keep-probability schedule theta(t) = (1 - theta_min) * exp(-gamma * t) +
+theta_min, updated by the engine each global step; models read
+``get_theta()`` (or ``get_state()``'s kwargs) and stochastically skip
+transformer blocks with probability 1 - theta * (i/L) per layer i — under
+jit the coin flips are taken with the step rng, so the schedule stays
+compiler-friendly (no Python control flow in the traced graph).
+"""
+
+from __future__ import annotations
+
+import math
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {theta})",
+                 ranks=[0])
+
+    def get_state(self) -> dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> None:
+        self.current_theta = (1.0 - self.theta) * \
+            math.exp(-self.gamma * global_step) + self.theta

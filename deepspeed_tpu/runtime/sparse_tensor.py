@@ -1,0 +1,52 @@
+"""Sparse gradient representation + allreduce (reference:
+runtime/sparse_tensor.py ``SparseTensor`` and the engine's
+``sparse_allreduce_bucket`` path engine.py:2446 — used for embedding
+gradients where only the looked-up rows are nonzero).
+
+Row-sparse COO over dim 0: (indices [k], values [k, ...]). The
+communication pattern matches the reference: all-gather indices+values
+across the dp group and scatter-add into dense (sparse-to-sparse reduce
+keeps the wire at O(nnz·W) instead of O(dense))."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class SparseTensor:
+    """Row-sparse view of a dense tensor (reference runtime/sparse_tensor.py)."""
+
+    def __init__(self, indices: jnp.ndarray, values: jnp.ndarray,
+                 dense_shape: Tuple[int, ...]):
+        self.indices = indices
+        self.values = values
+        self.dense_shape = tuple(dense_shape)
+
+    @classmethod
+    def from_dense(cls, x: jnp.ndarray, k: int) -> "SparseTensor":
+        """Keep the k rows with the largest l1 mass (static k keeps this
+        jittable; callers pick k = number of touched embedding rows)."""
+        mass = jnp.sum(jnp.abs(x), axis=tuple(range(1, x.ndim)))
+        _, idx = lax.top_k(mass, k)
+        idx = jnp.sort(idx)
+        return cls(idx, jnp.take(x, idx, axis=0), x.shape)
+
+    def to_dense(self) -> jnp.ndarray:
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def sparse_size(self) -> int:
+        return int(self.values.size + self.indices.size)
+
+
+def sparse_allreduce(st: SparseTensor, axis_names) -> SparseTensor:
+    """All-gather the (indices, values) pairs over the dp axes and return
+    the stacked sparse sum — call inside shard_map (reference
+    sparse_allreduce: all_gather indices + values, engine.py:2504)."""
+    idx = lax.all_gather(st.indices, axis_names, tiled=True)
+    vals = lax.all_gather(st.values, axis_names, tiled=True)
+    return SparseTensor(idx, vals, st.dense_shape)

@@ -1,0 +1,74 @@
+"""MP-sharded state-dict loading/merging (reference:
+runtime/state_dict_factory.py ``SDLoaderFactory``/``MegatronSDLoader`` —
+merge N tensor-parallel checkpoint shards into M, splitting or
+concatenating each weight along its TP dim).
+
+TPU form: checkpoints are pytrees; a merge/split plan is a tree of
+``axis`` ints (None = replicated — validated identical across shards).
+The inference engine's AutoTP path and universal checkpoint reshape reuse
+these primitives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import jax
+import numpy as np
+
+
+class SDLoaderFactory:
+    @staticmethod
+    def get_sd_loader_json(trees: List[Any], merge_axes: Any
+                           ) -> "MegatronSDLoader":
+        return MegatronSDLoader(trees, merge_axes)
+
+
+class MegatronSDLoader:
+    """Merge/split TP checkpoint shards (reference state_dict_factory.py
+    ``MegatronSDLoader.merge_state_dict/split_state_dict``)."""
+
+    def __init__(self, trees: List[Any], merge_axes: Any):
+        if not trees:
+            raise ValueError("need at least one checkpoint shard")
+        self.trees = trees
+        self.merge_axes = merge_axes
+
+    def merge_state_dict(self) -> Any:
+        """N shards -> 1 full tree: concat along each leaf's TP axis."""
+        def one(axis, *leaves):
+            if axis is None:
+                first = np.asarray(leaves[0])
+                for other in leaves[1:]:
+                    if not np.array_equal(first, np.asarray(other)):
+                        raise ValueError(
+                            "replicated leaf differs across shards")
+                return leaves[0]
+            return np.concatenate([np.asarray(l) for l in leaves],
+                                  axis=axis)
+
+        return jax.tree.map(one, self.merge_axes, *self.trees,
+                            is_leaf=lambda x: x is None)
+
+    def split_state_dict(self, num_shards: int) -> List[Any]:
+        """1 (merged) tree -> M shards along the same axes."""
+        full = self.merge_state_dict() if len(self.trees) > 1 \
+            else self.trees[0]
+
+        def split_leaf(axis, leaf):
+            if axis is None:
+                return [leaf] * num_shards
+            if leaf.shape[axis] % num_shards != 0:
+                raise ValueError(
+                    f"dim {axis} of {leaf.shape} not divisible by "
+                    f"{num_shards}")
+            return np.split(np.asarray(leaf), num_shards, axis=axis)
+
+        pieces = jax.tree.map(split_leaf, self.merge_axes, full,
+                              is_leaf=lambda x: x is None)
+        out = []
+        for r in range(num_shards):
+            out.append(jax.tree.map(
+                lambda p: p[r], pieces,
+                is_leaf=lambda x: isinstance(x, list)))
+        return out

@@ -1,0 +1,93 @@
+"""TiledLinear (reference: runtime/zero/tiling.py ``TiledLinear`` — splits
+a large linear into row/column tiles so ZeRO-3 only gathers one tile's
+weights at a time, bounding live parameter memory).
+
+Functional form: ``TiledLinear.init`` creates ``in_splits × out_splits``
+independent weight tiles (each a separate pytree leaf, so stage-3 shards
+and XLA gathers them independently); ``apply`` contracts tile-by-tile and
+accumulates. The reference's ``copy_params_from`` maps to
+:meth:`from_dense` / :meth:`to_dense`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _splits(total: int, parts: int) -> np.ndarray:
+    if total % parts != 0:
+        raise ValueError(f"dim {total} not divisible into {parts} tiles")
+    return np.full(parts, total // parts)
+
+
+class TiledLinear:
+    def __init__(self, in_features: int, out_features: int,
+                 in_splits: int = 1, out_splits: int = 1,
+                 use_bias: bool = True):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.in_splits = in_splits
+        self.out_splits = out_splits
+        self.use_bias = use_bias
+        self._in_sizes = _splits(in_features, in_splits)
+        self._out_sizes = _splits(out_features, out_splits)
+
+    # -------------------------------------------------------------- #
+    def init(self, rng: jax.Array, scale: float = 0.02) -> Dict[str, Any]:
+        params: Dict[str, Any] = {}
+        keys = jax.random.split(rng, self.in_splits * self.out_splits)
+        k = 0
+        for i in range(self.in_splits):
+            for o in range(self.out_splits):
+                params[f"tile_{i}_{o}"] = jax.random.normal(
+                    keys[k], (int(self._in_sizes[i]),
+                              int(self._out_sizes[o])), jnp.float32) * scale
+                k += 1
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.out_features,), jnp.float32)
+        return params
+
+    def apply(self, params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+        xs = jnp.split(x, self.in_splits, axis=-1)
+        outs = []
+        for o in range(self.out_splits):
+            acc = None
+            for i in range(self.in_splits):
+                part = xs[i] @ params[f"tile_{i}_{o}"].astype(x.dtype)
+                acc = part if acc is None else acc + part
+            outs.append(acc)
+        out = jnp.concatenate(outs, axis=-1)
+        if self.use_bias:
+            out = out + params["bias"].astype(out.dtype)
+        return out
+
+    # -------------------------------------------------------------- #
+    def from_dense(self, weight: jnp.ndarray,
+                   bias: jnp.ndarray = None) -> Dict[str, Any]:
+        """Dense [in, out] -> tile tree (reference copy_params_from)."""
+        params: Dict[str, Any] = {}
+        row0 = 0
+        for i in range(self.in_splits):
+            col0 = 0
+            for o in range(self.out_splits):
+                params[f"tile_{i}_{o}"] = weight[
+                    row0:row0 + int(self._in_sizes[i]),
+                    col0:col0 + int(self._out_sizes[o])]
+                col0 += int(self._out_sizes[o])
+            row0 += int(self._in_sizes[i])
+        if self.use_bias:
+            params["bias"] = (bias if bias is not None else
+                              jnp.zeros((self.out_features,), jnp.float32))
+        return params
+
+    def to_dense(self, params: Dict[str, Any]) -> jnp.ndarray:
+        rows = []
+        for i in range(self.in_splits):
+            rows.append(jnp.concatenate(
+                [params[f"tile_{i}_{o}"] for o in range(self.out_splits)],
+                axis=1))
+        return jnp.concatenate(rows, axis=0)

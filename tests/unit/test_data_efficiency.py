@@ -1,0 +1,163 @@
+"""Curriculum learning, random-LTD routing, progressive layer drop
+(reference: tests/unit/runtime/test_data_efficiency.py)."""
+
+import math
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler, RandomLTDScheduler, apply_random_ltd)
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from simple_model import SimpleModel, train_steps
+
+
+# ------------------------------------------------------------------ #
+# curriculum
+# ------------------------------------------------------------------ #
+def _cl(schedule_type, schedule):
+    return CurriculumScheduler({
+        "enabled": True, "curriculum_type": "seqlen",
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": schedule_type, "schedule_config": schedule})
+
+
+def test_fixed_linear_schedule():
+    cl = _cl("fixed_linear", {"total_curriculum_step": 100,
+                              "difficulty_step": 8})
+    # reference math: floor(t/T * (max-min) + min) rounded down to step
+    assert cl.update_difficulty(0) == 8
+    assert cl.update_difficulty(50) == 32  # 0.5*56+8=36 -> 32
+    assert cl.update_difficulty(100) == 64
+    assert cl.update_difficulty(500) == 64  # clamped
+
+
+def test_fixed_root_schedule():
+    cl = _cl("fixed_root", {"total_curriculum_step": 100,
+                            "difficulty_step": 8, "root_degree": 2})
+    d50 = cl.get_difficulty(50)
+    want = math.floor((0.5 ** 0.5) * 56 + 8)
+    want -= want % 8
+    assert d50 == want
+
+
+def test_fixed_discrete_schedule():
+    cl = _cl("fixed_discrete", {"difficulty": [8, 16, 64],
+                                "max_step": [10, 20]})
+    assert cl.get_difficulty(5) == 8
+    assert cl.get_difficulty(15) == 16
+    assert cl.get_difficulty(25) == 64
+
+
+def test_curriculum_monotone_nondecreasing():
+    cl = _cl("fixed_linear", {"total_curriculum_step": 50,
+                              "difficulty_step": 8})
+    vals = [cl.update_difficulty(t) for t in range(0, 80, 5)]
+    assert vals == sorted(vals)
+    assert vals[-1] == 64
+
+
+def test_curriculum_state_roundtrip():
+    cl = _cl("fixed_linear", {"total_curriculum_step": 50,
+                              "difficulty_step": 8})
+    cl.update_difficulty(25)
+    state = cl.get_state()
+    cl2 = _cl("fixed_linear", {"total_curriculum_step": 50,
+                               "difficulty_step": 8})
+    cl2.set_state(state)
+    assert cl2.get_current_difficulty() == cl.get_current_difficulty()
+
+
+# ------------------------------------------------------------------ #
+# random-LTD
+# ------------------------------------------------------------------ #
+def test_random_ltd_schedule_growth():
+    s = RandomLTDScheduler({"enabled": True, "random_ltd_schedule": {
+        "min_value": 16, "max_value": 64,
+        "schedule_config": {"seq_per_step": 16,
+                            "total_layer_token_step": 100}}})
+    assert s.update_seq(0) == 16
+    assert s.update_seq(50) == 32  # 16+0.5*48=40 -> 32
+    assert s.update_seq(100) == 64
+    assert s.update_seq(1000) == 64
+
+
+def test_apply_random_ltd_wraps_layer():
+    hidden = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 8))
+    calls = {}
+
+    def layer(h):
+        calls["shape"] = h.shape
+        return h * 3.0
+
+    out = apply_random_ltd(jax.random.PRNGKey(1), hidden, layer,
+                           reserved_length=8)
+    assert calls["shape"] == (2, 8, 8)
+    # each token is either tripled (kept) or untouched
+    ratio = np.asarray(out) / np.asarray(hidden)
+    tripled = np.isclose(ratio, 3.0).all(axis=-1)
+    kept = np.isclose(ratio, 1.0).all(axis=-1)
+    assert ((tripled | kept).all())
+    assert tripled.sum(axis=1).tolist() == [8, 8]
+
+
+def test_apply_random_ltd_full_length_passthrough():
+    hidden = jax.random.normal(jax.random.PRNGKey(2), (1, 16, 4))
+    out = apply_random_ltd(jax.random.PRNGKey(3), hidden,
+                           lambda h: h + 1.0, reserved_length=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(hidden) + 1.0)
+
+
+# ------------------------------------------------------------------ #
+# progressive layer drop
+# ------------------------------------------------------------------ #
+def test_pld_theta_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    pld.update_state(0)
+    assert pld.get_theta() == pytest.approx(1.0)
+    pld.update_state(100)
+    assert pld.get_theta() == pytest.approx(0.5 * math.exp(-1.0) + 0.5)
+    pld.update_state(10_000)
+    assert pld.get_theta() == pytest.approx(0.5, abs=1e-6)
+    assert pld.get_state()["progressive_layer_drop"] is True
+
+
+# ------------------------------------------------------------------ #
+# engine wiring
+# ------------------------------------------------------------------ #
+def test_engine_advances_schedulers():
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "curriculum_learning": {
+            "enabled": True, "curriculum_type": "seqlen",
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 8}},
+        "data_efficiency": {"data_routing": {"random_ltd": {
+            "enabled": True, "random_ltd_schedule": {
+                "min_value": 16, "max_value": 64,
+                "schedule_config": {"seq_per_step": 16,
+                                    "total_layer_token_step": 4}}}}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                   "gamma": 0.1},
+    }
+    m = SimpleModel(hidden_dim=16)
+    e, _, _, _ = deepspeed_tpu.initialize(model=(m.init, m.apply),
+                                          config=cfg)
+    assert e.get_data_difficulty() == 8
+    assert e.get_random_ltd_seq() == 16
+    assert e.get_pld_theta() == 1.0
+    train_steps(e, steps=4, batch=16, hidden_dim=16)
+    assert e.get_data_difficulty() == 64
+    assert e.get_random_ltd_seq() == 64
+    assert e.get_pld_theta() < 1.0

@@ -1,0 +1,210 @@
+"""Kernel-layer ops: transformer building blocks, sparse attention
+layouts, evoformer attention, random-LTD (reference: tests/unit/ops/ —
+kernel vs eager-composition numerics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import evoformer_attn as evo
+from deepspeed_tpu.ops import random_ltd as ltd
+from deepspeed_tpu.ops import sparse_attention as sa
+from deepspeed_tpu.ops import transformer as T
+from deepspeed_tpu.ops.op_builder import all_op_names, get_op_builder, op_report
+
+
+def _rand(shape, seed=0, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)
+
+
+# ------------------------------------------------------------------ #
+# registry: every entry must load (kills the round-1 vapor registry)
+# ------------------------------------------------------------------ #
+def test_all_op_builders_load():
+    for name in all_op_names():
+        mod = get_op_builder(name).load()
+        assert mod is not None, name
+    assert all(op_report().values()), op_report()
+
+
+# ------------------------------------------------------------------ #
+# transformer ops
+# ------------------------------------------------------------------ #
+def test_layer_norm_matches_manual():
+    x = _rand((4, 32), 1)
+    w, b = _rand((32,), 2), _rand((32,), 3)
+    got = T.layer_norm(x, w, b)
+    xf = np.asarray(x)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    want = (xf - mean) / np.sqrt(var + 1e-5) * np.asarray(w) + np.asarray(b)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_rms_norm_matches_manual():
+    x = _rand((4, 32), 4)
+    w = _rand((32,), 5)
+    got = T.rms_norm(x, w)
+    xf = np.asarray(x)
+    want = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-6) * \
+        np.asarray(w)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_gated_activation_silu():
+    x = _rand((2, 8), 6)
+    got = T.gated_activation(x, "silu")
+    g, u = np.split(np.asarray(x), 2, axis=-1)
+    want = g / (1 + np.exp(-g)) * u
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+
+def test_rotary_preserves_norm_and_dot_structure():
+    x = _rand((2, 16, 4, 32), 7)
+    pos = jnp.tile(jnp.arange(16)[None], (2, 1))
+    out = T.apply_rotary_pos_emb(x, pos)
+    # rotation preserves per-position norms
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+    # position 0 is the identity rotation
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(x[:, 0]),
+                               rtol=1e-6)
+
+
+def test_residual_add_tp_bias_division():
+    h, r = _rand((2, 8), 8), _rand((2, 8), 9)
+    bias = jnp.ones((8,))
+    out = T.residual_add(h, r, final_bias=bias, mp_size=4)
+    want = np.asarray(h) + np.asarray(r) + 0.25
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+
+
+# ------------------------------------------------------------------ #
+# sparse attention
+# ------------------------------------------------------------------ #
+def test_fixed_layout_local_windows():
+    cfg = sa.FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                                 num_global_blocks=1)
+    layout = cfg.make_layout(128)  # 8 blocks
+    assert layout.shape == (2, 8, 8)
+    # window [0,1]x[0,1] fully local
+    assert layout[0, 0, 1] and layout[0, 1, 0]
+    # global column (last block of each window) visible everywhere
+    assert layout[0, :, 1].all()
+    # non-global, non-local pair stays off
+    assert not layout[0, 0, 2]
+
+
+def test_fixed_layout_unidirectional_is_causal():
+    cfg = sa.FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                                 attention="unidirectional")
+    layout = cfg.make_layout(128)
+    assert not np.triu(layout[0], k=1).any()
+
+
+def test_bigbird_layout_window_and_globals():
+    cfg = sa.BigBirdSparsityConfig(num_heads=1, block=16,
+                                   num_random_blocks=1,
+                                   num_sliding_window_blocks=3,
+                                   num_global_blocks=1)
+    layout = cfg.make_layout(128)
+    n = 8
+    for r in range(n):
+        for c in range(max(0, r - 1), min(n, r + 2)):
+            assert layout[0, r, c]
+    assert layout[0, :, 0].all() and layout[0, 0, :].all()
+    assert layout[0, :, n - 1].all() and layout[0, n - 1, :].all()
+
+
+def test_longformer_layout():
+    cfg = sa.BSLongformerSparsityConfig(num_heads=1, block=16,
+                                        num_sliding_window_blocks=3,
+                                        global_block_indices=[0])
+    layout = cfg.make_layout(128)
+    assert layout[0, :, 0].all() and layout[0, 0, :].all()
+    assert not layout[0, 4, 7]
+
+
+def test_sparse_attention_dense_layout_matches_full():
+    q, k, v = (_rand((2, 2, 64, 16), s) for s in (1, 2, 3))
+    dense = sa.DenseSparsityConfig(num_heads=2, block=16).make_layout(64)
+    got = sa.sparse_self_attention(q, k, v, dense, block=16)
+    scores = np.einsum("bhsd,bhtd->bhst", np.asarray(q), np.asarray(k)) / 4.0
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.einsum("bhst,bhtd->bhsd", probs, np.asarray(v))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_attention_respects_layout():
+    q, k, v = (_rand((1, 1, 32, 8), s) for s in (4, 5, 6))
+    layout = np.zeros((1, 2, 2), dtype=bool)
+    layout[0, 0, 0] = layout[0, 1, 1] = True  # block-diagonal
+    got = sa.sparse_self_attention(q, k, v, layout, block=16)
+    # second half attends only to second half: changing first-half values
+    # must not affect it
+    v2 = v.at[:, :, :16].set(0.0)
+    got2 = sa.sparse_self_attention(q, k, v2, layout, block=16)
+    np.testing.assert_allclose(np.asarray(got[:, :, 16:]),
+                               np.asarray(got2[:, :, 16:]), rtol=1e-6)
+
+
+# ------------------------------------------------------------------ #
+# evoformer
+# ------------------------------------------------------------------ #
+def test_evoformer_attention_with_biases():
+    Q = _rand((2, 4, 16, 2, 8), 1)  # [b, n, seq, heads, dim]
+    K = _rand((2, 4, 16, 2, 8), 2)
+    V = _rand((2, 4, 16, 2, 8), 3)
+    mask_bias = jnp.where(_rand((2, 4, 1, 1, 16), 4) > 0, 0.0, -1e9)
+    pair_bias = _rand((2, 1, 2, 16, 16), 5)
+    out = evo.DS4Sci_EvoformerAttention(Q, K, V, [mask_bias, pair_bias])
+    assert out.shape == Q.shape
+    # manual composition
+    q = np.moveaxis(np.asarray(Q), -2, -3)
+    k = np.moveaxis(np.asarray(K), -2, -3)
+    v = np.moveaxis(np.asarray(V), -2, -3)
+    s = np.einsum("...hqd,...hkd->...hqk", q, k) / np.sqrt(8.0)
+    s = s + np.asarray(mask_bias) + np.asarray(pair_bias)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.moveaxis(np.einsum("...hqk,...hkd->...hqd", p, v), -3, -2)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------------ #
+# random-LTD
+# ------------------------------------------------------------------ #
+def test_random_ltd_sample_sorted_unique():
+    idx = ltd.sample_token_indices(jax.random.PRNGKey(0), 4, 64, 16)
+    assert idx.shape == (4, 16)
+    a = np.asarray(idx)
+    assert (np.diff(a, axis=1) > 0).all()  # sorted, unique
+
+
+def test_random_ltd_gather_scatter_roundtrip():
+    x = _rand((2, 32, 8), 1)
+    idx = ltd.sample_token_indices(jax.random.PRNGKey(1), 2, 32, 8)
+    sub = ltd.gather_tokens(x, idx)
+    assert sub.shape == (2, 8, 8)
+    back = ltd.scatter_tokens(x, sub * 2.0, idx)
+    got = ltd.gather_tokens(back, idx)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(sub) * 2.0,
+                               rtol=1e-6)
+    # untouched tokens stay identical
+    mask = np.ones(32, bool)
+    mask[np.asarray(idx)[0]] = False
+    np.testing.assert_array_equal(np.asarray(back)[0, mask],
+                                  np.asarray(x)[0, mask])
+
+
+def test_random_ltd_mask_slice():
+    mask = _rand((2, 1, 32, 32), 2)
+    idx = ltd.sample_token_indices(jax.random.PRNGKey(2), 2, 32, 8)
+    out = ltd.slice_attention_mask(mask, idx)
+    assert out.shape == (2, 1, 8, 8)
+    np.testing.assert_allclose(
+        np.asarray(out)[0, 0, 0, 0],
+        np.asarray(mask)[0, 0, int(idx[0, 0]), int(idx[0, 0])])

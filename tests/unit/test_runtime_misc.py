@@ -1,0 +1,148 @@
+"""Eigenvalue, sparse tensors, TiledLinear, state-dict factory, weight
+quantizer, activation checkpointing (reference: tests/unit/runtime/
+test_runtime_utils.py + sparse/eigenvalue/tiling suites)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.sparse_tensor import SparseTensor, sparse_allreduce
+from deepspeed_tpu.runtime.state_dict_factory import SDLoaderFactory
+from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+
+
+# ------------------------------------------------------------------ #
+def test_eigenvalue_quadratic():
+    """For loss = 0.5 x^T A x the Hessian is A: power iteration must find
+    A's top eigenvalue."""
+    rng = np.random.default_rng(0)
+    q, _ = np.linalg.qr(rng.normal(size=(8, 8)))
+    eigs = np.array([5.0, 3.0, 2.0, 1.0, 0.5, 0.2, 0.1, 0.05])
+    a = jnp.asarray((q * eigs) @ q.T, jnp.float32)
+
+    def loss(params):
+        x = params["x"]
+        return 0.5 * x @ a @ x
+
+    ev, vec = Eigenvalue(max_iter=200, tol=1e-6).compute_eigenvalue(
+        loss, {"x": jnp.ones((8,), jnp.float32)}, jax.random.PRNGKey(0))
+    assert float(ev) == pytest.approx(5.0, rel=1e-3)
+
+
+def test_sparse_tensor_roundtrip():
+    x = jnp.zeros((16, 4)).at[jnp.asarray([2, 7, 11])].set(1.5)
+    st = SparseTensor.from_dense(x, k=3)
+    assert sorted(np.asarray(st.indices).tolist()) == [2, 7, 11]
+    np.testing.assert_allclose(np.asarray(st.to_dense()), np.asarray(x))
+    assert st.sparse_size() < x.size
+
+
+def test_sparse_allreduce_matches_dense():
+    topo = groups.initialize_mesh()
+    dense = jax.random.normal(jax.random.PRNGKey(1), (16, 4))
+
+    def fn(x):
+        rank = jax.lax.axis_index("data")
+        # each device contributes 2 distinct hot rows
+        local = jnp.zeros_like(x).at[2 * rank].set(x[2 * rank]) \
+            .at[2 * rank + 1].set(x[2 * rank + 1])
+        st = SparseTensor.from_dense(local, k=2)
+        return sparse_allreduce(st, ("data",)).to_dense()
+
+    f = jax.shard_map(fn, mesh=topo.mesh, in_specs=P(), out_specs=P(None),
+                      check_vma=False)
+    out = f(dense)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=1e-6)
+
+
+def test_tiled_linear_matches_dense():
+    tl = TiledLinear(32, 48, in_splits=4, out_splits=3)
+    params = tl.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (5, 32))
+    got = tl.apply(params, x)
+    dense = tl.to_dense(params)
+    want = np.asarray(x) @ np.asarray(dense) + np.asarray(params["bias"])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+    # from_dense/to_dense roundtrip
+    again = tl.from_dense(dense, params["bias"])
+    np.testing.assert_allclose(np.asarray(tl.to_dense(again)),
+                               np.asarray(dense))
+
+
+def test_state_dict_factory_merge_split():
+    rng = np.random.default_rng(2)
+    full = {"wqkv": rng.normal(size=(16, 24)).astype(np.float32),
+            "norm": rng.normal(size=(16,)).astype(np.float32)}
+    axes = {"wqkv": 1, "norm": None}
+    shards = SDLoaderFactory.get_sd_loader_json([full], axes) \
+        .split_state_dict(4)
+    assert shards[0]["wqkv"].shape == (16, 6)
+    merged = SDLoaderFactory.get_sd_loader_json(shards, axes) \
+        .merge_state_dict()
+    np.testing.assert_allclose(merged["wqkv"], full["wqkv"])
+    np.testing.assert_allclose(merged["norm"], full["norm"])
+    # resharding 4 -> 2
+    two = SDLoaderFactory.get_sd_loader_json(shards, axes) \
+        .split_state_dict(2)
+    np.testing.assert_allclose(two[0]["wqkv"], full["wqkv"][:, :12])
+
+
+def test_weight_quantizer():
+    rng = np.random.default_rng(3)
+    params = {"attn": {"wq": jnp.asarray(
+        rng.normal(size=(64, 64)).astype(np.float32))},
+        "norm": jnp.ones((64,))}
+    wq = WeightQuantization(quantize_bits=8, quantize_groups=4)
+    qtree, count = wq.model_quantize(params, min_size=1024)
+    assert count == 1
+    assert WeightQuantization.is_quantized_record(qtree["attn"]["wq"])
+    assert qtree["norm"].dtype == jnp.float32  # small leaf untouched
+    deq = wq.dequantize_tree(qtree, dtype=jnp.float32)
+    err = np.abs(np.asarray(deq["attn"]["wq"]) -
+                 np.asarray(params["attn"]["wq"])).max()
+    assert err < np.abs(np.asarray(params["attn"]["wq"])).max() / 100
+
+
+def test_activation_checkpointing_api():
+    checkpointing.reset()
+    checkpointing.configure(partition_activations=True,
+                            checkpoint_in_cpu=False)
+    assert checkpointing.is_configured()
+
+    def layer(x):
+        return jnp.tanh(x) * 2.0
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 8))
+    out = checkpointing.checkpoint(layer, x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.tanh(np.asarray(x)) * 2.0, rtol=1e-6)
+    # gradients flow through the remat boundary
+    g = jax.grad(lambda v: checkpointing.checkpoint(layer, v).sum())(x)
+    want = 2.0 * (1 - np.tanh(np.asarray(x)) ** 2)
+    np.testing.assert_allclose(np.asarray(g), want, rtol=1e-5)
+    checkpointing.reset()
+
+
+def test_engine_configures_activation_checkpointing():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    import deepspeed_tpu
+    from simple_model import SimpleModel
+
+    checkpointing.reset()
+    m = SimpleModel(hidden_dim=16)
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "activation_checkpointing": {"partition_activations": True}}
+    deepspeed_tpu.initialize(model=(m.init, m.apply), config=cfg)
+    assert checkpointing.is_configured()
+    checkpointing.reset()
