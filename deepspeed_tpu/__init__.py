@@ -62,6 +62,13 @@ def initialize(args=None,
 
     from deepspeed_tpu.runtime.pipe.module import PipelineModule
 
+    # Normalise once so dispatch sees the parsed config regardless of
+    # whether the user passed a dict, a DeepSpeedConfig, or a JSON path.
+    cfg = cfg if isinstance(cfg, DeepSpeedConfig) else DeepSpeedConfig(cfg)
+
+    def _hybrid_enabled(c):
+        return bool(c.hybrid_engine.get("enabled", False))
+
     if isinstance(model, PipelineModule):
         from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
 
@@ -71,6 +78,15 @@ def initialize(args=None,
                                 base_param_specs=base_param_specs,
                                 batch_spec=batch_spec,
                                 lr_scheduler=lr_scheduler)
+    elif _hybrid_enabled(cfg):
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+        engine = DeepSpeedHybridEngine(model=model, config=cfg,
+                                       model_parameters=model_parameters,
+                                       loss_fn=loss_fn, topology=topology,
+                                       base_param_specs=base_param_specs,
+                                       batch_spec=batch_spec,
+                                       lr_scheduler=lr_scheduler)
     else:
         engine = DeepSpeedEngine(model=model, config=cfg,
                                  model_parameters=model_parameters,

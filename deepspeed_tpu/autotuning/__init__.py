@@ -1,0 +1,5 @@
+"""Autotuner (reference: deepspeed/autotuning/)."""
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner, Experiment
+
+__all__ = ["Autotuner", "Experiment"]

@@ -1,0 +1,26 @@
+"""Compression library (reference: deepspeed/compression/)."""
+
+from deepspeed_tpu.compression.basic_layer import (
+    CompressedLinear,
+    apply_mask,
+    channel_mask,
+    head_mask,
+    magnitude_mask,
+    row_mask,
+    ste_quantize_activation,
+    ste_quantize_weight,
+)
+from deepspeed_tpu.compression.compress import (
+    CompressionTransform,
+    init_compression,
+    layer_reduction_init,
+    redundancy_clean,
+)
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
+
+__all__ = [
+    "CompressedLinear", "CompressionScheduler", "CompressionTransform",
+    "apply_mask", "channel_mask", "head_mask", "init_compression",
+    "layer_reduction_init", "magnitude_mask", "redundancy_clean",
+    "row_mask", "ste_quantize_activation", "ste_quantize_weight",
+]

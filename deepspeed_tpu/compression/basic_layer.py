@@ -1,0 +1,153 @@
+"""Compression primitives (reference: compression/basic_layer.py —
+``LinearLayer_Compress``/``QuantAct``/``Embedding_Compress`` torch modules
+with quantization-aware training and pruning masks; utils.py TopK/STE
+helpers).
+
+Functional TPU form: pure transforms over weight arrays.
+``ste_quantize_*`` use a straight-through estimator (``custom_vjp``
+identity backward) so QAT gradients flow through the fake-quantized
+forward; pruning builds magnitude masks at sparse / row / channel / head
+granularity. A model applies these to its params inside the forward
+(``CompressedLinear``), or the engine-side
+:class:`~deepspeed_tpu.compression.compress.CompressionTransform` rewrites
+the param tree between steps.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.quantizer import fake_quantize
+
+__all__ = [
+    "ste_quantize_weight", "ste_quantize_activation", "magnitude_mask",
+    "row_mask", "channel_mask", "head_mask", "apply_mask",
+    "CompressedLinear",
+]
+
+
+# ------------------------------------------------------------------ #
+# quantization-aware training (STE)
+# ------------------------------------------------------------------ #
+@jax.custom_vjp
+def _ste(x: jnp.ndarray, qx: jnp.ndarray) -> jnp.ndarray:
+    return qx
+
+
+def _ste_fwd(x, qx):
+    return qx, None
+
+
+def _ste_bwd(_res, g):
+    return g, None  # gradient passes straight through to x
+
+
+_ste.defvjp(_ste_fwd, _ste_bwd)
+
+
+def ste_quantize_weight(w: jnp.ndarray, bits: int, groups: int = 1,
+                        symmetric: bool = True) -> jnp.ndarray:
+    """Fake-quantize with straight-through gradients (reference
+    LinearLayer_Compress weight QAT path)."""
+    return _ste(w, fake_quantize(w, groups, bits, symmetric))
+
+
+def ste_quantize_activation(x: jnp.ndarray, bits: int,
+                            range_calibration: str = "dynamic",
+                            static_range: float = 1.0) -> jnp.ndarray:
+    """QuantAct: per-tensor activation fake-quant with STE. ``dynamic``
+    calibrates the range per call; ``static`` uses the provided range."""
+    hi = float(2 ** (bits - 1) - 1)
+    if range_calibration == "dynamic":
+        scale = jnp.max(jnp.abs(x)).astype(jnp.float32) / hi
+        scale = jnp.where(scale > 0, scale, 1.0)
+    else:
+        scale = jnp.asarray(static_range / hi, jnp.float32)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -hi, hi) * scale
+    return _ste(x, q.astype(x.dtype))
+
+
+# ------------------------------------------------------------------ #
+# pruning masks
+# ------------------------------------------------------------------ #
+def magnitude_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Unstructured: keep the top ``dense_ratio`` fraction by |w|
+    (reference sparse_pruning method 'l1')."""
+    k = max(1, int(round(dense_ratio * w.size)))
+    flat = jnp.abs(w.reshape(-1))
+    thresh = jax.lax.top_k(flat, k)[0][-1]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def row_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Structured: keep rows (output neurons, dim -1) with largest l1 mass
+    (reference row_pruning)."""
+    mass = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    k = max(1, int(round(dense_ratio * w.shape[-1])))
+    thresh = jax.lax.top_k(mass, k)[0][-1]
+    keep = (mass >= thresh).astype(w.dtype)
+    return jnp.broadcast_to(keep, w.shape)
+
+
+def channel_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Structured: keep input channels (dim 0) with largest l1 mass
+    (reference channel_pruning)."""
+    mass = jnp.sum(jnp.abs(w), axis=tuple(range(1, w.ndim)))
+    k = max(1, int(round(dense_ratio * w.shape[0])))
+    thresh = jax.lax.top_k(mass, k)[0][-1]
+    keep = (mass >= thresh).astype(w.dtype)
+    return keep.reshape((-1,) + (1,) * (w.ndim - 1)) * jnp.ones_like(w)
+
+
+def head_mask(w: jnp.ndarray, dense_ratio: float,
+              num_heads: int) -> jnp.ndarray:
+    """Structured: keep attention heads with largest l1 mass; ``w`` is an
+    attention projection [in, heads*head_dim] (reference head_pruning)."""
+    if w.shape[-1] % num_heads != 0:
+        raise ValueError(f"last dim {w.shape[-1]} not divisible by "
+                         f"{num_heads} heads")
+    hd = w.shape[-1] // num_heads
+    per_head = jnp.sum(jnp.abs(w.reshape(-1, num_heads, hd)), axis=(0, 2))
+    k = max(1, int(round(dense_ratio * num_heads)))
+    thresh = jax.lax.top_k(per_head, k)[0][-1]
+    keep = (per_head >= thresh).astype(w.dtype)
+    return jnp.broadcast_to(jnp.repeat(keep, hd), w.shape)
+
+
+def apply_mask(w: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Masked weight with STE so pruned weights keep receiving gradients
+    until the mask is frozen (reference's mask-in-forward)."""
+    return _ste(w, w * mask)
+
+
+class CompressedLinear:
+    """Functional compressed linear (reference LinearLayer_Compress):
+    applies configured QAT + pruning inside the forward."""
+
+    def __init__(self, bits: Optional[int] = None, groups: int = 1,
+                 dense_ratio: Optional[float] = None,
+                 pruning: str = "sparse", num_heads: int = 1):
+        self.bits = bits
+        self.groups = groups
+        self.dense_ratio = dense_ratio
+        self.pruning = pruning
+        self.num_heads = num_heads
+
+    def __call__(self, params, x):
+        w = params["kernel"]
+        if self.dense_ratio is not None:
+            fn = {"sparse": magnitude_mask, "row": row_mask,
+                  "channel": channel_mask}.get(self.pruning)
+            mask = fn(w, self.dense_ratio) if fn is not None else \
+                head_mask(w, self.dense_ratio, self.num_heads)
+            w = apply_mask(w, mask)
+        if self.bits is not None:
+            w = ste_quantize_weight(w, self.bits, self.groups)
+        out = x @ w.astype(x.dtype)
+        if "bias" in params:
+            out = out + params["bias"].astype(out.dtype)
+        return out

@@ -1,0 +1,237 @@
+"""Compression entry points (reference: compression/compress.py —
+``init_compression:100`` walks the model and swaps layers for compressed
+variants per the ``compression_training`` config; ``redundancy_clean:148``
+physically removes pruned structures after training; helper.py group
+matching).
+
+TPU form: the model stays untouched — :class:`CompressionTransform`
+rewrites the *param tree* (fake-quantize / mask weights matching each
+``different_groups`` module-scope pattern) according to the scheduler's
+active techniques, and :func:`redundancy_clean` shrinks pruned rows/
+channels out of the arrays. Apply the transform to ``engine.params``
+inside the training loop (or wrap the model's apply with it).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.compression import basic_layer as BL
+from deepspeed_tpu.compression.scheduler import (CompressionScheduler,
+                                                 TECHNIQUES)
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["init_compression", "redundancy_clean", "CompressionTransform",
+           "get_compression_config"]
+
+
+def get_compression_config(ds_config: Dict[str, Any]) -> Dict[str, Any]:
+    return (ds_config or {}).get("compression_training", {})
+
+
+def _match_groups(technique_cfg: Dict[str, Any], leaf_names: List[str]
+                  ) -> List[Tuple[str, List[str], Dict[str, Any]]]:
+    """Resolve ``different_groups`` module-scope patterns against the
+    '/'-joined param paths (reference compress.py:59 group walk).
+    '*' matches everything; patterns are regex searched."""
+    out = []
+    for gname, gcfg in technique_cfg.get("different_groups", {}).items():
+        scopes = gcfg.get("modules", ["*"])
+        params = gcfg.get("params", {})
+        matched: List[str] = []
+        for pat in scopes:
+            if pat == "*":
+                matched = list(leaf_names)
+                break
+            rx = re.compile(pat.replace("*", ".*"))
+            matched += [n for n in leaf_names if rx.search(n)]
+        out.append((gname, sorted(set(matched)), params))
+    return out
+
+
+class CompressionTransform:
+    """Step-aware param-tree compression (QAT fake-quant + pruning masks).
+
+    Masks are computed when a technique first activates and FROZEN
+    thereafter (the reference freezes masks at schedule_offset too), so
+    pruned coordinates stay pruned while training continues.
+    """
+
+    def __init__(self, compression_config: Dict[str, Any]):
+        self.config = compression_config
+        self.scheduler = CompressionScheduler(compression_config)
+        self._masks: Dict[str, Any] = {}
+
+    # -------------------------------------------------------------- #
+    def _leaf_names(self, params) -> Dict[str, Any]:
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        return {"/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                         for k in path): leaf for path, leaf in flat}
+
+    def _mask_for(self, technique: str, name: str, leaf, params_cfg):
+        key = f"{technique}:{name}"
+        if key not in self._masks:
+            ratio = float(params_cfg.get("dense_ratio", 0.5))
+            if technique == "sparse_pruning":
+                self._masks[key] = BL.magnitude_mask(leaf, ratio)
+            elif technique == "row_pruning":
+                self._masks[key] = BL.row_mask(leaf, ratio)
+            elif technique == "channel_pruning":
+                self._masks[key] = BL.channel_mask(leaf, ratio)
+            elif technique == "head_pruning":
+                self._masks[key] = BL.head_mask(
+                    leaf, ratio, int(params_cfg.get("num_heads", 1)))
+        return self._masks[key]
+
+    def __call__(self, params, global_step: int):
+        """Return the compressed view of ``params`` for this step."""
+        leaves = self._leaf_names(params)
+        names = [n for n, l in leaves.items()
+                 if getattr(l, "ndim", 0) >= 2]
+        replacements: Dict[str, Any] = {}
+        for technique in TECHNIQUES:
+            if technique == "activation_quantization":
+                continue  # applied in the model forward, not on weights
+            if not self.scheduler.is_active(technique, global_step):
+                continue
+            tcfg = self.config.get(technique, {})
+            for _g, matched, pcfg in _match_groups(tcfg, names):
+                for name in matched:
+                    w = replacements.get(name, leaves[name])
+                    if technique == "weight_quantization":
+                        bits = self.scheduler.current_bits(global_step, pcfg)
+                        groups = int(pcfg.get("quantize_groups", 1))
+                        w = BL.ste_quantize_weight(w, bits, groups)
+                    else:
+                        w = BL.apply_mask(
+                            w, self._mask_for(technique, name,
+                                              leaves[name], pcfg))
+                    replacements[name] = w
+        if not replacements:
+            return params
+
+        def rebuild(path, leaf):
+            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in path)
+            return replacements.get(name, leaf)
+
+        return jax.tree_util.tree_map_with_path(rebuild, params)
+
+
+def init_compression(model_or_params, deepspeed_config: Dict[str, Any],
+                     teacher_model=None, mpu=None) -> CompressionTransform:
+    """reference ``init_compression:100`` — returns the transform (and
+    logs layer reduction when configured; the student keeps
+    ``keep_number_layer`` layers mapped from ``teacher_layer``)."""
+    cfg = get_compression_config(deepspeed_config)
+    lr_cfg = cfg.get("layer_reduction", {})
+    if lr_cfg.get("enabled", False):
+        logger.info(
+            f"layer reduction: keep {lr_cfg.get('keep_number_layer')} "
+            f"layers from teacher layers {lr_cfg.get('teacher_layer')}")
+    return CompressionTransform(cfg)
+
+
+def layer_reduction_init(params: Any, keep_layers: List[int],
+                         layer_prefix: str = "layer_") -> Any:
+    """Build a student param tree keeping only ``keep_layers`` (teacher
+    layer indices), renumbered densely (reference layer_reduction student
+    init)."""
+    if not isinstance(params, dict):
+        raise TypeError("layer_reduction_init expects a dict param tree")
+
+    def sort_key(k):
+        # numeric layer order, not lexicographic ('layer_10' after 'layer_9')
+        if k.startswith(layer_prefix):
+            suffix = k[len(layer_prefix):]
+            if suffix.isdigit():
+                return (1, int(suffix))
+        return (0, k)
+
+    out = {}
+    new_idx = 0
+    for key in sorted(params, key=sort_key):
+        if key.startswith(layer_prefix):
+            try:
+                idx = int(key[len(layer_prefix):])
+            except ValueError:
+                out[key] = params[key]
+                continue
+            if idx in keep_layers:
+                out[f"{layer_prefix}{new_idx}"] = params[key]
+                new_idx += 1
+        else:
+            out[key] = params[key]
+    return out
+
+
+def redundancy_clean(params: Any, deepspeed_config: Dict[str, Any],
+                     mpu=None,
+                     transform: Optional[CompressionTransform] = None
+                     ) -> Any:
+    """reference ``redundancy_clean:148`` — physically remove pruned
+    structures: rows (last dim) and channels (dim 0) whose mask is zero
+    are sliced out, shrinking the arrays for deployment.
+
+    Pass the ``transform`` used during training so cleanup removes exactly
+    the structures its FROZEN masks pruned; without it the keep set is
+    recomputed from post-training magnitudes, which can disagree with the
+    trained function (pruned-but-regrown weights) — a warning is logged.
+    """
+    cfg = get_compression_config(deepspeed_config)
+    if transform is None:
+        logger.warning(
+            "redundancy_clean: no training CompressionTransform supplied; "
+            "recomputing masks from current magnitudes (may differ from "
+            "the masks used in training)")
+        transform = CompressionTransform(cfg)
+    leaves = transform._leaf_names(params)
+    names = [n for n, l in leaves.items() if getattr(l, "ndim", 0) >= 2]
+    to_clean: Dict[str, Any] = {}
+    for technique in ("row_pruning", "channel_pruning"):
+        tcfg = cfg.get(technique, {})
+        if not tcfg.get("shared_parameters", {}).get("enabled", False):
+            continue
+        for _g, matched, pcfg in _match_groups(tcfg, names):
+            for name in matched:
+                w = np.asarray(to_clean.get(name, leaves[name]))
+                mask_key = f"{technique}:{name}"
+                frozen = transform._masks.get(mask_key)
+                if technique == "row_pruning":
+                    if frozen is not None:
+                        keep = np.where(np.asarray(frozen).any(
+                            axis=tuple(range(frozen.ndim - 1))))[0]
+                    else:
+                        mass = np.abs(w).sum(
+                            axis=tuple(range(w.ndim - 1)))
+                        k = max(1, int(round(
+                            float(pcfg.get("dense_ratio", 0.5)) *
+                            w.shape[-1])))
+                        keep = np.sort(np.argsort(-mass)[:k])
+                    w = np.take(w, keep, axis=-1)
+                else:
+                    if frozen is not None:
+                        keep = np.where(np.asarray(frozen).any(
+                            axis=tuple(range(1, frozen.ndim))))[0]
+                    else:
+                        mass = np.abs(w).sum(axis=tuple(range(1, w.ndim)))
+                        k = max(1, int(round(
+                            float(pcfg.get("dense_ratio", 0.5)) *
+                            w.shape[0])))
+                        keep = np.sort(np.argsort(-mass)[:k])
+                    w = np.take(w, keep, axis=0)
+                to_clean[name] = w
+    if not to_clean:
+        return params
+
+    def rebuild(path, leaf):
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        return jnp.asarray(to_clean[name]) if name in to_clean else leaf
+
+    return jax.tree_util.tree_map_with_path(rebuild, params)

@@ -1,0 +1,70 @@
+"""Compression scheduler (reference: compression/scheduler.py
+``compression_scheduler`` — activates each technique once training passes
+its ``schedule_offset`` and, for weight quantization, anneals the bit
+width from ``start_bits`` to ``target_bits`` every
+``quantization_period`` steps).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from deepspeed_tpu.utils.logging import logger
+
+TECHNIQUES = ("weight_quantization", "activation_quantization",
+              "sparse_pruning", "row_pruning", "head_pruning",
+              "channel_pruning")
+
+
+class CompressionScheduler:
+    def __init__(self, compression_config: Dict[str, Any]):
+        self.config = compression_config or {}
+        self.verbose = {t: False for t in TECHNIQUES}
+
+    def _shared(self, technique: str) -> Dict[str, Any]:
+        return self.config.get(technique, {}).get("shared_parameters", {})
+
+    def is_enabled(self, technique: str) -> bool:
+        return bool(self._shared(technique).get("enabled", False))
+
+    def is_active(self, technique: str, global_step: int) -> bool:
+        """Technique participates once past its schedule_offset (and
+        before schedule_offset_end if set)."""
+        if not self.is_enabled(technique):
+            return False
+        shared = self._shared(technique)
+        start = int(shared.get("schedule_offset", 0))
+        end = shared.get("schedule_offset_end")
+        active = global_step >= start and (end is None or
+                                           global_step <= int(end))
+        if active and not self.verbose[technique]:
+            logger.info(f"compression: {technique} active from step "
+                        f"{global_step}")
+            self.verbose[technique] = True
+        return active
+
+    def current_bits(self, global_step: int, group_params: Dict[str, Any]
+                     ) -> int:
+        """Annealed bit width for weight quantization (reference
+        scheduler.py quantization_period logic): start_bits steps down to
+        target_bits, halving the distance every period."""
+        start = int(group_params.get("start_bits", 8))
+        target = int(group_params.get("target_bits", start))
+        period = int(self._shared("weight_quantization")
+                     .get("quantization_period",
+                          group_params.get("quantization_period", 0)) or 0)
+        offset = int(self._shared("weight_quantization")
+                     .get("schedule_offset", 0))
+        if period <= 0 or global_step < offset:
+            return start
+        steps = (global_step - offset) // period
+        bits = start
+        for _ in range(steps):
+            if bits <= target:
+                break
+            bits = max(target, bits // 2 if bits > target * 2
+                       else target)
+        return max(bits, target)
+
+    def step(self, global_step: int) -> Dict[str, bool]:
+        return {t: self.is_active(t, global_step) for t in TECHNIQUES}

@@ -314,6 +314,7 @@ class DeepSpeedConfig:
         self.curriculum_learning = pd.get("curriculum_learning", {})
         self.data_efficiency = pd.get("data_efficiency", {})
         self.progressive_layer_drop = pd.get("progressive_layer_drop", {})
+        self.hybrid_engine = pd.get("hybrid_engine", {})
         self.compression_config = pd.get("compression_training", {})
         self.monitor_config = None  # assembled by MonitorMaster
 

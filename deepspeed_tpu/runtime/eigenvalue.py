@@ -34,9 +34,11 @@ class Eigenvalue:
         return jax.tree.map(jnp.nan_to_num, tree)
 
     def normalize(self, tree: Any) -> Any:
-        sq = sum(jnp.sum(jnp.square(l)) for l in jax.tree.leaves(tree))
+        sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                 for l in jax.tree.leaves(tree))
         inv = jax.lax.rsqrt(sq + self.stability)
-        return jax.tree.map(lambda l: l * inv, tree)
+        # keep each leaf's dtype: tangents must match primals under jvp
+        return jax.tree.map(lambda l: (l * inv).astype(l.dtype), tree)
 
     def compute_eigenvalue(self, loss_fn: Callable[[Any], jnp.ndarray],
                            params: Any, rng: jax.Array
@@ -52,8 +54,8 @@ class Eigenvalue:
         keys = jax.random.split(rng, len(leaves))
         v0 = self.normalize(jax.tree_util.tree_unflatten(
             treedef,
-            [jax.random.normal(k, l.shape, jnp.float32)
-             for k, l in zip(keys, leaves)]))
+            [jax.random.normal(k, l.shape, l.dtype)  # tangent dtype must
+             for k, l in zip(keys, leaves)]))        # match the primal's
 
         def body(carry):
             i, v, prev_ev, _done = carry

@@ -1,0 +1,62 @@
+"""Autotuner (reference: tests/unit/autotuning/test_autotuning.py)."""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from deepspeed_tpu.autotuning import Autotuner
+from simple_model import SimpleModel
+
+HIDDEN = 16
+
+
+def _batch_fn(n):
+    rng = np.random.default_rng(0)
+    return (rng.normal(size=(n, HIDDEN)).astype(np.float32),
+            rng.normal(size=(n, HIDDEN)).astype(np.float32))
+
+
+def _tuner(tmp_path, **kw):
+    m = SimpleModel(hidden_dim=HIDDEN)
+    base = {"optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+    return Autotuner((m.init, m.apply), base, _batch_fn,
+                     results_dir=str(tmp_path / "results"), **kw)
+
+
+def test_tune_picks_config_and_writes_records(tmp_path):
+    tuner = _tuner(tmp_path, micro_batch_sizes=[2, 4], zero_stages=[0, 2],
+                   steps_per_trial=2)
+    best = tuner.tune()
+    assert best["train_micro_batch_size_per_gpu"] in (2, 4)
+    assert best["zero_optimization"]["stage"] in (0, 2)
+    results = list((tmp_path / "results").glob("*.json"))
+    assert len(results) == 5  # 4 experiments + best.json
+    rec = json.loads((tmp_path / "results" / "best.json").read_text())
+    assert rec["best_metric_val"] > 0
+
+
+def test_memory_model_filters_infeasible(tmp_path):
+    tuner = _tuner(tmp_path, micro_batch_sizes=[2], zero_stages=[0, 3],
+                   hbm_bytes=1.0)  # nothing fits
+    with pytest.raises(RuntimeError, match="every experiment failed"):
+        tuner.tune()
+    assert tuner.records == []  # all filtered before running
+
+
+def test_memory_model_prefers_sharded_stages(tmp_path):
+    tuner = _tuner(tmp_path)
+    b0 = tuner.estimate_state_bytes(0, world=8)
+    b3 = tuner.estimate_state_bytes(3, world=8)
+    assert b3 < b0 / 4
+
+
+def test_model_based_order(tmp_path):
+    tuner = _tuner(tmp_path, tuner_type="model_based",
+                   micro_batch_sizes=[2], zero_stages=[0, 3])
+    cands = tuner._candidates()
+    assert cands[0]["zero_stage"] == 3  # cheapest memory first

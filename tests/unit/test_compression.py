@@ -1,0 +1,208 @@
+"""Compression: QAT/STE, pruning masks, scheduler, transform, cleanup
+(reference: tests/unit/compression/test_compression.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression import (
+    CompressionScheduler, CompressionTransform, apply_mask, channel_mask,
+    head_mask, init_compression, layer_reduction_init, magnitude_mask,
+    redundancy_clean, row_mask, ste_quantize_activation,
+    ste_quantize_weight)
+
+
+def _rand(shape, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+# ------------------------------------------------------------------ #
+# STE
+# ------------------------------------------------------------------ #
+def test_ste_weight_quant_gradient_passes_through():
+    w = _rand((8, 8), 1)
+
+    def loss(w):
+        return jnp.sum(ste_quantize_weight(w, bits=4, groups=2) ** 2)
+
+    g = jax.grad(loss)(w)
+    # straight-through: grad == 2 * fake_quant(w), and nonzero everywhere
+    q = ste_quantize_weight(w, 4, 2)
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(q), rtol=1e-5)
+
+
+def test_ste_activation_quant():
+    x = _rand((16,), 2)
+    q = ste_quantize_activation(x, bits=8)
+    assert float(jnp.abs(q - x).max()) < float(jnp.abs(x).max()) / 100
+    g = jax.grad(lambda v: ste_quantize_activation(v, 8).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+# ------------------------------------------------------------------ #
+# masks
+# ------------------------------------------------------------------ #
+def test_magnitude_mask_ratio():
+    w = _rand((32, 32), 3)
+    m = magnitude_mask(w, 0.25)
+    assert float(m.sum()) == pytest.approx(0.25 * w.size, rel=0.01)
+    # kept entries are the largest
+    assert float(jnp.abs(w * m).max()) == float(jnp.abs(w).max())
+
+
+def test_row_and_channel_masks_structured():
+    w = _rand((16, 32), 4)
+    rm = row_mask(w, 0.5)
+    cols = np.asarray(rm).all(axis=0)  # a column is fully kept or dropped
+    assert cols.sum() == 16
+    assert ((np.asarray(rm) == 1) | (np.asarray(rm) == 0)).all()
+    cm = channel_mask(w, 0.25)
+    rows = np.asarray(cm).all(axis=1)
+    assert rows.sum() == 4
+
+
+def test_head_mask():
+    w = _rand((16, 8 * 4), 5)  # 8 heads x dim 4
+    hm = head_mask(w, 0.5, num_heads=8)
+    per_head = np.asarray(hm).reshape(16, 8, 4)
+    kept = per_head.all(axis=(0, 2))
+    assert kept.sum() == 4
+
+
+def test_apply_mask_ste_grads():
+    w = _rand((8, 8), 6)
+    mask = magnitude_mask(w, 0.5)
+    g = jax.grad(lambda v: apply_mask(v, mask).sum())(w)
+    np.testing.assert_allclose(np.asarray(g), 1.0)  # grads flow to pruned
+
+
+# ------------------------------------------------------------------ #
+# scheduler + transform
+# ------------------------------------------------------------------ #
+def _cfg():
+    return {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                                  "quantization_period": 2},
+            "different_groups": {"wq1": {
+                "params": {"start_bits": 8, "target_bits": 4,
+                           "quantize_groups": 2},
+                "modules": ["layer_0"]}}},
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 1},
+            "different_groups": {"sp1": {
+                "params": {"dense_ratio": 0.5}, "modules": ["*"]}}},
+    }}
+
+
+def test_scheduler_offsets_and_bit_annealing():
+    sched = CompressionScheduler(_cfg()["compression_training"])
+    assert not sched.is_active("weight_quantization", 1)
+    assert sched.is_active("weight_quantization", 2)
+    assert sched.is_active("sparse_pruning", 1)
+    p = {"start_bits": 8, "target_bits": 4}
+    assert sched.current_bits(1, p) == 8
+    assert sched.current_bits(2, p) == 8
+    assert sched.current_bits(4, p) == 4
+    assert sched.current_bits(100, p) == 4
+
+
+def test_transform_rewrites_matching_leaves():
+    params = {"layer_0": {"kernel": _rand((16, 16), 7)},
+              "layer_1": {"kernel": _rand((16, 16), 8)},
+              "norm": _rand((16,), 9)}
+    tr = init_compression(params, _cfg())
+    out0 = tr(params, global_step=0)  # nothing active
+    np.testing.assert_array_equal(np.asarray(out0["layer_0"]["kernel"]),
+                                  np.asarray(params["layer_0"]["kernel"]))
+    out = tr(params, global_step=3)
+    # sparse pruning active on all 2D leaves: half the entries zeroed
+    k1 = np.asarray(out["layer_1"]["kernel"])
+    assert (k1 == 0).mean() == pytest.approx(0.5, abs=0.01)
+    # weight quantization additionally active on layer_0
+    k0 = np.asarray(out["layer_0"]["kernel"])
+    assert not np.array_equal(k0, np.asarray(params["layer_0"]["kernel"]))
+    # 1D leaf untouched
+    np.testing.assert_array_equal(np.asarray(out["norm"]),
+                                  np.asarray(params["norm"]))
+    # masks frozen: same zero pattern at a later step
+    out2 = tr(params, global_step=10)
+    np.testing.assert_array_equal(np.asarray(out2["layer_1"]["kernel"]) == 0,
+                                  k1 == 0)
+
+
+def test_transform_trains():
+    """QAT + pruning in a toy loop: loss still decreases."""
+    params = {"w": _rand((16, 16), 10) * 0.2}
+    x = _rand((32, 16), 11)
+    y = _rand((32, 16), 12)
+    cfg = {"compression_training": {"sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"sp": {"params": {"dense_ratio": 0.5},
+                                    "modules": ["*"]}}}}}
+    tr = init_compression(params, cfg)
+
+    @jax.jit
+    def step(p, t):
+        def loss(p):
+            cp = tr(p, 1)
+            return jnp.mean((x @ cp["w"] - y) ** 2)
+
+        l, g = jax.value_and_grad(loss)(p)
+        return jax.tree.map(lambda a, b: a - 0.1 * b, p, g), l
+
+    losses = []
+    for _ in range(20):
+        params, l = step(params, None)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_redundancy_clean_shrinks():
+    params = {"layer_0": {"kernel": _rand((16, 32), 13)}}
+    cfg = {"compression_training": {"row_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"rp": {"params": {"dense_ratio": 0.5},
+                                    "modules": ["layer_0"]}}}}}
+    out = redundancy_clean(params, cfg)
+    assert out["layer_0"]["kernel"].shape == (16, 16)
+
+
+def test_layer_reduction_init():
+    params = {f"layer_{i}": {"w": jnp.ones((2,)) * i} for i in range(6)}
+    params["embed"] = jnp.zeros((4,))
+    student = layer_reduction_init(params, keep_layers=[1, 3, 5])
+    assert sorted(student) == ["embed", "layer_0", "layer_1", "layer_2"]
+    assert float(student["layer_0"]["w"][0]) == 1.0
+    assert float(student["layer_2"]["w"][0]) == 5.0
+
+
+def test_redundancy_clean_uses_frozen_masks():
+    """Cleanup with the training transform removes exactly the rows its
+    frozen mask pruned, even if pruned rows regrew larger magnitudes."""
+    params = {"layer_0": {"kernel": _rand((8, 8), 14)}}
+    cfg = {"compression_training": {"row_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"rp": {"params": {"dense_ratio": 0.5},
+                                    "modules": ["layer_0"]}}}}}
+    tr = init_compression(params, cfg)
+    tr(params, global_step=1)  # freeze masks now
+    frozen = np.asarray(tr._masks["row_pruning:layer_0/kernel"])
+    kept_cols = np.where(frozen.any(axis=0))[0]
+    # adversarially boost a PRUNED column's magnitude post-training
+    pruned_cols = [c for c in range(8) if c not in kept_cols]
+    boosted = params["layer_0"]["kernel"].at[:, pruned_cols[0]].set(100.0)
+    out = redundancy_clean({"layer_0": {"kernel": boosted}}, cfg,
+                           transform=tr)
+    np.testing.assert_array_equal(
+        np.asarray(out["layer_0"]["kernel"]),
+        np.asarray(boosted)[:, kept_cols])
+
+
+def test_layer_reduction_numeric_order():
+    params = {f"layer_{i}": {"w": jnp.ones((2,)) * i} for i in range(12)}
+    student = layer_reduction_init(params, keep_layers=[0, 5, 10])
+    assert float(student["layer_0"]["w"][0]) == 0.0
+    assert float(student["layer_1"]["w"][0]) == 5.0
+    assert float(student["layer_2"]["w"][0]) == 10.0
