@@ -58,6 +58,7 @@ class Autotuner:
                  steps_per_trial: int = 3,
                  fast: bool = False,
                  hbm_bytes: Optional[float] = None,
+                 activation_bytes_per_sample: Optional[float] = None,
                  peak_flops: float = 2e14, peak_bw: float = 8e11,
                  seed: int = 0):
         """``sample_batch_fn(micro_batch)`` returns the engine-call args
@@ -77,6 +78,7 @@ class Autotuner:
         self.steps_per_trial = steps_per_trial
         self.fast = fast
         self.hbm_bytes = hbm_bytes
+        self.activation_bytes_per_sample = activation_bytes_per_sample
         self.peak_flops = peak_flops  # roofline peaks for fast mode
         self.peak_bw = peak_bw
         self.rng = np.random.default_rng(seed)
@@ -119,9 +121,15 @@ class Autotuner:
         return p_bytes + opt_bytes + grad_bytes
 
     def feasible(self, stage: int, micro_batch: int, world: int) -> bool:
+        """Memory prefilter. Models optimizer/param state exactly; the
+        activation term needs ``activation_bytes_per_sample`` (caller-
+        provided — the tuner cannot derive it from an opaque model)."""
         if self.hbm_bytes is None:
             return True
-        return self.estimate_state_bytes(stage, world) < self.hbm_bytes
+        need = self.estimate_state_bytes(stage, world)
+        if self.activation_bytes_per_sample is not None:
+            need += micro_batch * self.activation_bytes_per_sample
+        return need < self.hbm_bytes
 
     # -------------------------------------------------------------- #
     def _candidates(self) -> List[Dict[str, Any]]:
@@ -159,6 +167,10 @@ class Autotuner:
             engine, _, _, _ = deepspeed_tpu.initialize(
                 model=self.model, config=exp.config,
                 topology=groups.get_topology())
+            if self.fast:
+                # fast mode inspects the micro program's cost analysis, so
+                # keep micro/apply as separate programs
+                engine._can_fuse_step = lambda: False
             args = self.sample_batch_fn(
                 exp.config["train_micro_batch_size_per_gpu"] *
                 engine.dp_world_size)

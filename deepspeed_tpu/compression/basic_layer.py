@@ -131,6 +131,9 @@ class CompressedLinear:
     def __init__(self, bits: Optional[int] = None, groups: int = 1,
                  dense_ratio: Optional[float] = None,
                  pruning: str = "sparse", num_heads: int = 1):
+        if pruning not in ("sparse", "row", "channel", "head"):
+            raise ValueError(f"unknown pruning kind {pruning!r}; expected "
+                             f"sparse/row/channel/head")
         self.bits = bits
         self.groups = groups
         self.dense_ratio = dense_ratio

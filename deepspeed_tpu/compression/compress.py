@@ -48,7 +48,13 @@ def _match_groups(technique_cfg: Dict[str, Any], leaf_names: List[str]
             if pat == "*":
                 matched = list(leaf_names)
                 break
-            rx = re.compile(pat.replace("*", ".*"))
+            # substring match (the reference's `key_word in module_name`),
+            # but digit-ending patterns must not prefix-match longer
+            # indices: 'layer_1' matches layer_1/... not layer_10/...
+            body = re.escape(pat).replace(r"\*", ".*")
+            if pat[-1].isdigit():
+                body += r"(?!\d)"
+            rx = re.compile(body)
             matched += [n for n in leaf_names if rx.search(n)]
         out.append((gname, sorted(set(matched)), params))
     return out
@@ -76,6 +82,13 @@ class CompressionTransform:
     def _mask_for(self, technique: str, name: str, leaf, params_cfg):
         key = f"{technique}:{name}"
         if key not in self._masks:
+            if isinstance(leaf, jax.core.Tracer):
+                raise RuntimeError(
+                    f"compression: mask for {key} would be frozen from a "
+                    f"jit tracer (it would silently recompute every step "
+                    f"and leak). Call transform.freeze_masks(params, step) "
+                    f"with concrete params OUTSIDE jit first — the frozen "
+                    f"masks then embed as constants in the compiled step.")
             ratio = float(params_cfg.get("dense_ratio", 0.5))
             if technique == "sparse_pruning":
                 self._masks[key] = BL.magnitude_mask(leaf, ratio)
@@ -87,6 +100,13 @@ class CompressionTransform:
                 self._masks[key] = BL.head_mask(
                     leaf, ratio, int(params_cfg.get("num_heads", 1)))
         return self._masks[key]
+
+    def freeze_masks(self, params, global_step: int) -> None:
+        """Compute and freeze every active technique's masks from concrete
+        ``params`` — call once (outside jit) when a pruning technique
+        activates; subsequent jitted ``__call__``s embed the masks as
+        constants, guaranteeing the documented frozen semantics."""
+        self(params, global_step)
 
     def __call__(self, params, global_step: int):
         """Return the compressed view of ``params`` for this step."""

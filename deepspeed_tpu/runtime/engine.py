@@ -286,6 +286,8 @@ class DeepSpeedEngine:
         self._jit_micro: Optional[Callable] = None
         self._jit_apply: Optional[Callable] = None
         self._jit_eval: Optional[Callable] = None
+        self._jit_fused: Optional[Callable] = None
+        self._pending_step = None  # (gnorm, overflow) from a fused forward
         self._micro_compiled = None  # AOT executables (flops profiler path)
         self._apply_compiled = None
         self._apply_in_shapes = None
@@ -568,8 +570,9 @@ class DeepSpeedEngine:
             donate_argnums=(1,),
             out_shardings=(sh["acc_grads"], NamedSharding(self.mesh, P())))
 
-    def _build_apply(self):
-        sh = self._state_shardings()
+    def _make_apply_step(self):
+        """The pure optimizer-step closure, shared by the standalone apply
+        program and the fused micro+apply program."""
         clip = float(self.config.gradient_clipping)
         fp16 = self.fp16_enabled
         dynamic = self.dynamic_loss_scale
@@ -642,11 +645,58 @@ class DeepSpeedEngine:
             })
             return new_state, gnorm, overflow
 
+        return apply_step
+
+    def _build_apply(self):
+        sh = self._state_shardings()
         scalar = NamedSharding(self.mesh, P())
         self._jit_apply = jax.jit(
-            apply_step,
+            self._make_apply_step(),
             donate_argnums=(0,),
             out_shardings=(dict(sh), scalar, scalar))
+
+    def _can_fuse_step(self) -> bool:
+        """One combined micro+apply program per optimizer step — valid when
+        every micro step IS a boundary (gas=1) and no phase/placement
+        machinery needs a host hop between gradient and update (offload
+        transfers, 1-bit phase switch, ZeRO++ manual micro, flops-profiler
+        AOT bookkeeping). Halves the per-step dispatch count — significant
+        over remote-tunnel backends — and lets XLA overlap the optimizer
+        with the backward tail."""
+        zc = self.config.zero_config
+        return (self.config.gradient_accumulation_steps == 1
+                and not self._onebit
+                and self._offload_plan is None and not self._offload_device
+                and not zc.zero_quantized_gradients
+                and not (zc.zero_quantized_weights and self.zero_stage >= 3)
+                and not self.config.flops_profiler.enabled)
+
+    def _build_fused_step(self):
+        """micro (loss+grads) and optimizer apply in ONE jitted program."""
+        sh = self._state_shardings()
+        gas = self._grad_accum_divisor()
+        apply_step = self._make_apply_step()
+
+        def fused(state, lr, rng, *args):
+            def scaled_loss_fn(p):
+                out = self._apply_fn(p, *args, rng=rng, train=True)
+                loss, _aux = self._loss_from_outputs(out, args)
+                return loss.astype(jnp.float32) * \
+                    (state["loss_scale"] / gas), loss
+
+            grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
+            (_, loss), grads = grad_fn(state["params"])
+            acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                               state["acc_grads"], grads)
+            new_state, gnorm, overflow = apply_step(
+                {**state, "acc_grads": acc}, lr)
+            return new_state, loss, gnorm, overflow
+
+        scalar = NamedSharding(self.mesh, P())
+        self._jit_fused = jax.jit(
+            fused,
+            donate_argnums=(0,),
+            out_shardings=(dict(sh), scalar, scalar, scalar))
 
     def _build_eval(self):
         def ev(params, rng, *args):
@@ -671,10 +721,32 @@ class DeepSpeedEngine:
             if self._jit_eval is None:
                 self._build_eval()
             return self._jit_eval(self.state["params"], rng, *args)
-        if self._jit_micro is None:
-            self._build_micro()
+        if self._jit_micro is None and self._jit_fused is None:
+            if self._can_fuse_step():
+                self._build_fused_step()
+            else:
+                self._build_micro()
         if self.micro_steps % self.config.gradient_accumulation_steps == 0:
             self.tput_timer.start()
+        if self._jit_fused is not None:
+            # one program: loss+grads+optimizer (see _can_fuse_step)
+            if self._pending_step is not None:
+                raise RuntimeError(
+                    "fused step: at gradient_accumulation_steps=1 every "
+                    "forward() applies the optimizer update — call "
+                    "backward() and step() before the next forward() "
+                    "(use engine.eval() to compute a loss without "
+                    "updating)")
+            lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+            self.timers(FORWARD_MICRO_TIMER).start()
+            self.state, loss, gnorm, overflow = self._jit_fused(
+                self.state, lr, rng, *args)
+            self.timers(FORWARD_MICRO_TIMER).stop(
+                sync_obj=loss if self.config.wall_clock_breakdown else None)
+            self._pending_step = (gnorm, overflow)
+            self._last_loss = loss
+            self._seen_backward = False
+            return loss
         self.timers(FORWARD_MICRO_TIMER).start()
         inputs = (self.state["params"], self.state["acc_grads"],
                   self.state["loss_scale"], rng) + args
@@ -744,6 +816,8 @@ class DeepSpeedEngine:
         (reference engine.step:2111 -> _take_model_step:2045)"""
         if not self.is_gradient_accumulation_boundary():
             return
+        if self._pending_step is not None:
+            return self._finish_fused_step()
         if self._onebit_compression_stage():
             return self._onebit_step()
         if self._jit_apply is None:
@@ -858,6 +932,40 @@ class DeepSpeedEngine:
         if self.progressive_layer_drop is None:
             return 1.0
         return self.progressive_layer_drop.get_theta()
+
+    def _finish_fused_step(self):
+        """Bookkeeping half of a step whose device work already ran inside
+        the fused forward program."""
+        gnorm, overflow = self._pending_step
+        self._pending_step = None
+        tput_sync = (self.config.wall_clock_breakdown
+                     or (self.tput_timer.global_step_count + 1)
+                     % self.tput_timer.steps_per_output == 0)
+        self.tput_timer.stop(
+            global_step=True,
+            sync_obj=self.state["loss_scale"] if tput_sync else None)
+        self.global_steps += 1
+        self._update_data_efficiency()
+        if self.fp16_enabled and bool(jax.device_get(overflow)):
+            self.skipped_steps += 1
+            log_dist(
+                f"step {self.global_steps}: fp16 overflow, skipping update "
+                f"(loss scale -> "
+                f"{float(jax.device_get(self.state['loss_scale']))})",
+                ranks=[0])
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step(self.global_steps)
+        if self.global_steps % self.config.steps_per_print == 0:
+            if self.config.wall_clock_breakdown:
+                self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER],
+                                memory_breakdown=True)
+            if self.monitor.enabled:
+                self.monitor.write_events([
+                    ("Train/lr", self.get_lr()[0], self.global_steps),
+                    ("Train/samples_per_sec",
+                     self.tput_timer.avg_samples_per_sec(),
+                     self.global_steps)])
+        return gnorm
 
     def _onebit_compression_stage(self) -> bool:
         return self._onebit and self.global_steps >= \
@@ -986,6 +1094,9 @@ class DeepSpeedEngine:
         path, client_state = load_engine_state(
             self, load_dir, tag,
             load_optimizer_states=load_optimizer_states and not load_module_only)
+        # the loaded state supersedes any update applied by a fused
+        # init-forward; drop its pending bookkeeping
+        self._pending_step = None
         if self._offload_plan is not None:
             self._offload_transfer(to_host=True)  # restore host residency
         if client_state:

@@ -56,7 +56,6 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._he_cfg = he
         self._lora_scaling = float(he.get("lora_scaling", 1.0))
         self._inference_engine = None
-        self._in_generate = False
         log_dist("DeepSpeedHybridEngine: sharing training weights with "
                  "the inference path (no gather/copy)", ranks=[0])
 
@@ -95,11 +94,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         (reference generate:174)."""
         inf = self._get_inference_engine()
         inf.params = self._generation_params()
-        self._in_generate = True
-        try:
-            return inf.generate(input_ids, **kwargs)
-        finally:
-            self._in_generate = False
+        return inf.generate(input_ids, **kwargs)
 
     # reference API parity: explicit fuse/unfuse are no-ops on the
     # training tree (fusion happens on a temporary view per generate)
@@ -109,6 +104,3 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
     def unfuse_lora_weight(self):
         pass
-
-    def eval(self):
-        return self.train(False)

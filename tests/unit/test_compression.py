@@ -142,6 +142,7 @@ def test_transform_trains():
         "different_groups": {"sp": {"params": {"dense_ratio": 0.5},
                                     "modules": ["*"]}}}}}
     tr = init_compression(params, cfg)
+    tr.freeze_masks(params, 1)  # concrete masks BEFORE jit traces
 
     @jax.jit
     def step(p, t):
@@ -206,3 +207,26 @@ def test_layer_reduction_numeric_order():
     assert float(student["layer_0"]["w"][0]) == 0.0
     assert float(student["layer_1"]["w"][0]) == 5.0
     assert float(student["layer_2"]["w"][0]) == 10.0
+
+
+def test_transform_refuses_tracer_mask_freeze():
+    """Freezing a mask from a jit tracer would silently break the frozen
+    semantics; the transform must fail loudly instead."""
+    params = {"w": _rand((8, 8), 20)}
+    cfg = {"compression_training": {"sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"sp": {"params": {"dense_ratio": 0.5},
+                                    "modules": ["*"]}}}}}
+    tr = init_compression(params, cfg)
+    with pytest.raises(Exception, match="freeze_masks"):
+        jax.jit(lambda p: tr(p, 1))(params)
+
+
+def test_group_matching_numeric_suffix():
+    from deepspeed_tpu.compression.compress import _match_groups
+
+    names = [f"layer_{i}/kernel" for i in range(12)]
+    groups = _match_groups(
+        {"different_groups": {"g": {"modules": ["layer_1"], "params": {}}}},
+        names)
+    assert groups[0][1] == ["layer_1/kernel"]
