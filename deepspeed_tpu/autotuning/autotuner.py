@@ -164,13 +164,13 @@ class Autotuner:
         from deepspeed_tpu.parallel import groups
 
         try:
-            engine, _, _, _ = deepspeed_tpu.initialize(
-                model=self.model, config=exp.config,
-                topology=groups.get_topology())
             if self.fast:
                 # fast mode inspects the micro program's cost analysis, so
                 # keep micro/apply as separate programs
-                engine._can_fuse_step = lambda: False
+                exp.config["fuse_optimizer_step"] = False
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model, config=exp.config,
+                topology=groups.get_topology())
             args = self.sample_batch_fn(
                 exp.config["train_micro_batch_size_per_gpu"] *
                 engine.dp_world_size)

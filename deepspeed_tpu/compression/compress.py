@@ -45,6 +45,9 @@ def _match_groups(technique_cfg: Dict[str, Any], leaf_names: List[str]
         params = gcfg.get("params", {})
         matched: List[str] = []
         for pat in scopes:
+            if not pat:
+                raise ValueError(
+                    "compression: empty string in a 'modules' scope list")
             if pat == "*":
                 matched = list(leaf_names)
                 break

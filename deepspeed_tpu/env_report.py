@@ -1,0 +1,75 @@
+"""Environment report — ``ds_report`` (reference: deepspeed/env_report.py,
+bin/ds_report): framework/runtime versions, accelerator inventory, op
+availability, native-library status.
+
+Run as ``python -m deepspeed_tpu.env_report``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _safe(fn, default="unavailable"):
+    try:
+        return fn()
+    except Exception as e:  # noqa: BLE001
+        if isinstance(default, str):
+            return f"{default} ({type(e).__name__})"
+        return default  # non-string defaults (e.g. []) pass through typed
+
+
+def collect_report() -> dict:
+    import jax
+
+    from deepspeed_tpu.accelerator import get_accelerator
+    from deepspeed_tpu.ops import native
+    from deepspeed_tpu.ops.op_builder import op_report
+    from deepspeed_tpu.version import __version__
+
+    devices = _safe(lambda: jax.devices(), default=[])
+    return {
+        "deepspeed_tpu": __version__,
+        "python": sys.version.split()[0],
+        "jax": jax.__version__,
+        "jaxlib": _safe(lambda: __import__("jaxlib").__version__),
+        "flax": _safe(lambda: __import__("flax").__version__),
+        "accelerator": _safe(lambda: get_accelerator().device_name()),
+        "platform": _safe(lambda: devices[0].platform) if devices
+        else "unavailable",
+        "device_kind": _safe(lambda: devices[0].device_kind) if devices
+        else "unavailable",
+        "device_count": len(devices),
+        "process_count": _safe(lambda: jax.process_count()),
+        "native_host_ops": native.available(),
+        "ops": op_report(),
+    }
+
+
+def main() -> int:
+    r = collect_report()
+    print("-" * 60)
+    print("DeepSpeed-TPU environment report (ds_report)")
+    print("-" * 60)
+    for key in ("deepspeed_tpu", "python", "jax", "jaxlib", "flax"):
+        print(f"{key:.<28} {r[key]}")
+    print("-" * 60)
+    for key in ("accelerator", "platform", "device_kind", "device_count",
+                "process_count"):
+        print(f"{key:.<28} {r[key]}")
+    print("-" * 60)
+    print(f"{'native host ops (csrc)':.<28} "
+          f"{GREEN_OK if r['native_host_ops'] else RED_NO}")
+    print("op compatibility:")
+    for name, ok in sorted(r["ops"].items()):
+        print(f"  {name:.<26} {GREEN_OK if ok else RED_NO}")
+    print("-" * 60)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,3 +10,12 @@ __all__ = ["LlamaConfig", "LlamaForCausalLM", "GPT2Config",
 from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
 
 __all__ += ["MixtralConfig", "MixtralForCausalLM"]
+from deepspeed_tpu.models.mistral import (
+    MistralConfig,
+    MistralForCausalLM,
+    mistral_tiny,
+)
+from deepspeed_tpu.models.opt import OPTConfig, OPTForCausalLM
+
+__all__ += ["MistralConfig", "MistralForCausalLM", "mistral_tiny",
+            "OPTConfig", "OPTForCausalLM"]

@@ -49,6 +49,9 @@ class LlamaConfig:
     # layouts where the automatic merge misses.
     fused_qkv: bool = False
     fused_gate_up: bool = False
+    # Mistral-style sliding-window attention: each token attends to at
+    # most the previous `sliding_window` positions (None = full causal).
+    sliding_window: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -157,7 +160,14 @@ class LlamaAttention(nn.Module):
         k = apply_rotary(k, cos, sin)
         attn = attention_fn or dot_product_attention
         if cache is None:
-            out = attn(q, k, v, causal=True)
+            if cfg.sliding_window is not None and \
+                    x.shape[1] > cfg.sliding_window:
+                # Mistral SWA: the window is a first-class kernel argument
+                # (flash path skips out-of-band k-blocks; no dense mask)
+                out = attn(q, k, v, causal=True,
+                           window=cfg.sliding_window)
+            else:
+                out = attn(q, k, v, causal=True)
             new_cache = None
         else:
             # write the new keys/values at cache_index
@@ -178,6 +188,10 @@ class LlamaAttention(nn.Module):
                 key_pos = jnp.arange(max_len, dtype=jnp.int32)
                 mask = key_pos[None, None, None, :] <= \
                     positions[:, None, :, None]
+                if cfg.sliding_window is not None:
+                    mask = mask & (key_pos[None, None, None, :] >
+                                   positions[:, None, :, None] -
+                                   cfg.sliding_window)
                 out = attn(q, ck, cv, causal=False, mask=mask)
         out = out.reshape(*x.shape[:2], h * d)
         return dense(cfg.hidden_size, "o_proj")(out), new_cache

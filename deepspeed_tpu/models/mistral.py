@@ -1,0 +1,49 @@
+"""Mistral causal LM (parity target: the reference's mistral support —
+inference/v2/model_implementations/mistral/, containers policy).
+
+Mistral-7B is the Llama architecture with grouped-query attention and
+sliding-window attention (SWA, window 4096) plus a larger rope theta; the
+TPU implementation therefore *is* :class:`LlamaForCausalLM` driven by a
+config with ``sliding_window`` set — the banded mask lives in
+``LlamaAttention`` (models/llama.py), and the KV cache/decode path applies
+the same window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import (
+    LLAMA_PARTITION_RULES,
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+
+MISTRAL_PARTITION_RULES = LLAMA_PARTITION_RULES
+
+
+def MistralConfig(**kw) -> LlamaConfig:
+    """Mistral-7B-v0.1 defaults over the shared Llama-architecture config."""
+    base = dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                num_hidden_layers=32, num_attention_heads=32,
+                num_key_value_heads=8, max_position_embeddings=32768,
+                rope_theta=10000.0, sliding_window=4096)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def mistral_tiny(**kw) -> LlamaConfig:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=128,
+                sliding_window=16)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+class MistralForCausalLM(LlamaForCausalLM):
+    """Same module tree as Llama (HF mistral uses identical param names up
+    to prefixes); the sliding window comes from the config."""

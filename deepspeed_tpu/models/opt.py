@@ -1,0 +1,144 @@
+"""OPT causal LM (parity target: the reference's OPT support —
+module_inject/containers/opt.py policy,
+inference/v2/model_implementations/opt/).
+
+OPT-125M..66B architecture: learned positional embeddings with the
+characteristic offset of 2 (padding slots), pre-LayerNorm decoder blocks,
+ReLU MLP, final layer norm, tied unembedding. Engine contract matches the
+other model families: ``__call__(input_ids, labels)`` returns the loss
+when labels are given.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.llama import cross_entropy_loss
+from deepspeed_tpu.ops.attention import dot_product_attention
+
+OPT_POSITION_OFFSET = 2  # HF OPTLearnedPositionalEmbedding offset
+
+
+@dataclasses.dataclass
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    ffn_dim: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    do_layer_norm_before: bool = True  # pre-LN (True for all but 350M)
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def opt_125m(**kw) -> "OPTConfig":
+        return OPTConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "OPTConfig":
+        base = dict(vocab_size=256, hidden_size=64, ffn_dim=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=128)
+        base.update(kw)
+        return OPTConfig(**base)
+
+
+OPT_PARTITION_RULES = [
+    (r"embed_tokens/embedding", P("model", None)),
+    (r"embed_positions/embedding", P()),
+    (r"(q_proj|k_proj|v_proj)/kernel", P(None, "model")),
+    (r"out_proj/kernel", P("model", None)),
+    (r"fc1/kernel", P(None, "model")),
+    (r"fc2/kernel", P("model", None)),
+    (r".*norm.*", P()),
+]
+
+
+class OPTAttention(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h, d = cfg.num_attention_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        q = dense(h * d, "q_proj")(x).reshape(*x.shape[:2], h, d)
+        k = dense(h * d, "k_proj")(x).reshape(*x.shape[:2], h, d)
+        v = dense(h * d, "v_proj")(x).reshape(*x.shape[:2], h, d)
+        out = dot_product_attention(q, k, v, causal=True)
+        return dense(cfg.hidden_size, "out_proj")(
+            out.reshape(*x.shape[:2], h * d))
+
+
+class OPTBlock(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                       dtype=jnp.float32, name=name)
+        residual = x
+        h = ln("self_attn_layer_norm")(x) if cfg.do_layer_norm_before else x
+        h = OPTAttention(cfg, name="self_attn")(h)
+        x = residual + h
+        if not cfg.do_layer_norm_before:
+            x = ln("self_attn_layer_norm")(x)
+        residual = x
+        h = ln("final_layer_norm")(x) if cfg.do_layer_norm_before else x
+        h = nn.Dense(cfg.ffn_dim, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="fc1")(h)
+        h = nn.relu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="fc2")(h)
+        x = residual + h
+        if not cfg.do_layer_norm_before:
+            x = ln("final_layer_norm")(x)
+        return x
+
+
+class OPTForCausalLM(nn.Module):
+    config: OPTConfig
+
+    @property
+    def partition_rules(self):
+        return OPT_PARTITION_RULES
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.config
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                         param_dtype=jnp.float32, dtype=cfg.dtype,
+                         name="embed_tokens")
+        pos_embed = nn.Embed(
+            cfg.max_position_embeddings + OPT_POSITION_OFFSET,
+            cfg.hidden_size, param_dtype=jnp.float32, dtype=cfg.dtype,
+            name="embed_positions")
+        s = input_ids.shape[1]
+        x = embed(input_ids) + pos_embed(
+            jnp.arange(s, dtype=jnp.int32) + OPT_POSITION_OFFSET)
+        block = OPTBlock
+        if cfg.remat:
+            block = nn.remat(OPTBlock)
+        for i in range(cfg.num_hidden_layers):
+            x = block(cfg, name=f"layers_{i}")(x)
+        if cfg.do_layer_norm_before:
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                             name="final_layer_norm")(x)
+        logits = embed.attend(x.astype(jnp.float32))  # tied unembedding
+        if labels is not None:
+            return cross_entropy_loss(logits, labels)
+        return logits

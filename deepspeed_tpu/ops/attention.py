@@ -18,8 +18,13 @@ import jax.numpy as jnp
 def dot_product_attention(q, k, v, *, causal: bool = True,
                           mask: Optional[jax.Array] = None,
                           scale: Optional[float] = None,
+                          window: Optional[int] = None,
                           implementation: str = "auto"):
-    """q: [B,Sq,H,D]; k/v: [B,Sk,Hkv,D] (GQA when Hkv < H)."""
+    """q: [B,Sq,H,D]; k/v: [B,Sk,Hkv,D] (GQA when Hkv < H).
+
+    ``window``: Mistral-style causal sliding window — handled natively by
+    the flash kernel (out-of-band blocks skipped); the XLA path applies a
+    banded mask."""
     if implementation in ("auto", "pallas"):
         try:
             from deepspeed_tpu.ops.flash_attention import (
@@ -31,11 +36,12 @@ def dot_product_attention(q, k, v, *, causal: bool = True,
             if implementation == "pallas" or flash_attention_usable(q, k, v, causal,
                                                                     mask):
                 return flash_attention(q, k, v, causal=causal, mask=mask,
-                                       scale=scale)
-    return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale)
+                                       scale=scale, window=window)
+    return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale,
+                          window=window)
 
 
-def _xla_attention(q, k, v, *, causal, mask, scale):
+def _xla_attention(q, k, v, *, causal, mask, scale, window=None):
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
@@ -49,6 +55,9 @@ def _xla_attention(q, k, v, *, causal, mask, scale):
                         preferred_element_type=jnp.float32) * scale
     if causal:
         causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        if window is not None:
+            causal_mask &= ~jnp.tril(jnp.ones((sq, sk), bool),
+                                     k=sk - sq - window)
         logits = jnp.where(causal_mask[None, None], logits, -1e30)
     if mask is not None:
         logits = jnp.where(mask, logits, -1e30)

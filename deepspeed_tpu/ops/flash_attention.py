@@ -82,7 +82,7 @@ def flash_attention_usable(q, k, v, causal, mask) -> bool:
 # ===================================================================== #
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
-                num_k_blocks, causal_offset):
+                num_k_blocks, causal_offset, window):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -92,9 +92,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # skip blocks entirely above the causal diagonal
+    # skip blocks entirely above the causal diagonal, and (sliding
+    # window) blocks entirely below the band col > row - window
     run = jnp.logical_or(not causal,
                          (iq + 1) * block_q - 1 + causal_offset >= ik * block_k)
+    if window is not None:
+        run = jnp.logical_and(
+            run,
+            (ik + 1) * block_k - 1 > iq * block_q + causal_offset - window)
 
     @pl.when(run)
     def _():
@@ -108,7 +113,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 jnp.int32, (block_q, block_k), 0)
             cols = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows + causal_offset >= cols, s, NEG_INF)
+            keep = rows + causal_offset >= cols
+            if window is not None:
+                keep = jnp.logical_and(
+                    keep, cols > rows + causal_offset - window)
+            s = jnp.where(keep, s, NEG_INF)
 
         m_prev = m_ref[:, :1]                          # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -134,7 +143,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                                                      l_ref[:]))
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
+         window=None):
     """q:[B,H,Sq,D] k/v:[B,Hkv,Sk,D] -> (o:[B,H,Sq,D], lse:[B,H,Sq])."""
     b, h, sq, d = q.shape
     _, hkv, sk, _ = k.shape
@@ -145,7 +155,8 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=nk, causal_offset=sk - sq)
+        block_k=block_k, num_k_blocks=nk, causal_offset=sk - sq,
+        window=window)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -181,7 +192,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
 # ===================================================================== #
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_acc, *, scale, causal, block_q, block_k, num_k_blocks,
-                   causal_offset):
+                   causal_offset, window):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -191,6 +202,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     run = jnp.logical_or(not causal,
                          (iq + 1) * block_q - 1 + causal_offset >= ik * block_k)
+    if window is not None:
+        run = jnp.logical_and(
+            run,
+            (ik + 1) * block_k - 1 > iq * block_q + causal_offset - window)
 
     @pl.when(run)
     def _():
@@ -207,7 +222,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 jnp.int32, (block_q, block_k), 0)
             cols = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows + causal_offset >= cols, s, NEG_INF)
+            keep = rows + causal_offset >= cols
+            if window is not None:
+                keep = jnp.logical_and(
+                    keep, cols > rows + causal_offset - window)
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse)                          # [bq, bk]
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -223,7 +242,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, num_q_blocks, causal_offset):
+                    block_q, block_k, num_q_blocks, causal_offset, window):
     ik = pl.program_id(2)
     iq = pl.program_id(3)
 
@@ -234,6 +253,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = jnp.logical_or(not causal,
                          (iq + 1) * block_q - 1 + causal_offset >= ik * block_k)
+    if window is not None:
+        run = jnp.logical_and(
+            run,
+            (ik + 1) * block_k - 1 > iq * block_q + causal_offset - window)
 
     @pl.when(run)
     def _():
@@ -250,7 +273,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0)
             cols = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows + causal_offset >= cols, s, NEG_INF)
+            keep = rows + causal_offset >= cols
+            if window is not None:
+                keep = jnp.logical_and(
+                    keep, cols > rows + causal_offset - window)
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse)                           # [bq, bk]
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -268,7 +295,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(res, grads, *, scale, causal, block_q, block_k, interpret):
+def _bwd(res, grads, *, scale, causal, block_q, block_k, interpret,
+         window=None):
     q, k, v, o, lse = res
     do = grads[0]
     b, h, sq, d = q.shape
@@ -285,7 +313,7 @@ def _bwd(res, grads, *, scale, causal, block_q, block_k, interpret):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          causal_offset=sk - sq),
+                          causal_offset=sk - sq, window=window),
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
@@ -312,7 +340,7 @@ def _bwd(res, grads, *, scale, causal, block_q, block_k, interpret):
     dk_h, dv_h = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
-                          causal_offset=sk - sq),
+                          causal_offset=sk - sq, window=window),
         grid=(b, h, nk, nq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
@@ -354,22 +382,22 @@ def _bwd(res, grads, *, scale, causal, block_q, block_k, interpret):
 # ===================================================================== #
 # Public entry
 # ===================================================================== #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret, window):
     o, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
-                block_k=block_k, interpret=interpret)
+                block_k=block_k, interpret=interpret, window=window)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window):
     o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, interpret=interpret)
+                  block_k=block_k, interpret=interpret, window=window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, window, res, g):
     return _bwd(res, (g,), scale=scale, causal=causal, block_q=block_q,
-                block_k=block_k, interpret=interpret)
+                block_k=block_k, interpret=interpret, window=window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -378,18 +406,27 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, *, causal: bool = True,
                     mask: Optional[jax.Array] = None,
                     scale: Optional[float] = None,
+                    window: Optional[int] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """Flash attention. q: [B,Sq,H,D]; k/v: [B,Sk,Hkv,D]; returns [B,Sq,H,D].
+
+    ``window`` (requires ``causal``) restricts each query to the previous
+    ``window`` keys — Mistral sliding-window attention, with out-of-band
+    k-blocks skipped entirely (O(s*w) work, no dense mask).
 
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU so the
     exact kernel code is testable on the CPU mesh.
     """
     if mask is not None:
         raise NotImplementedError(
-            "flash_attention supports causal/full only; use "
-            "ops.attention.dot_product_attention for custom masks")
+            "flash_attention supports causal/full (+sliding window) only; "
+            "use ops.attention.dot_product_attention for custom masks")
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
+    if window is not None and window <= 0:
+        raise ValueError(f"window must be positive, got {window}")
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
     if h % hkv != 0:
@@ -407,5 +444,6 @@ def flash_attention(q, k, v, *, causal: bool = True,
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     o = _flash(qt, kt, vt, float(scale), bool(causal), int(block_q),
-               int(block_k), bool(interpret))
+               int(block_k), bool(interpret),
+               int(window) if window is not None else None)
     return o.transpose(0, 2, 1, 3)

@@ -315,6 +315,9 @@ class DeepSpeedConfig:
         self.data_efficiency = pd.get("data_efficiency", {})
         self.progressive_layer_drop = pd.get("progressive_layer_drop", {})
         self.hybrid_engine = pd.get("hybrid_engine", {})
+        # single fused micro+apply program at gas=1 (set False to keep the
+        # split programs, e.g. to inspect the micro's cost analysis)
+        self.fuse_optimizer_step = bool(pd.get("fuse_optimizer_step", True))
         self.compression_config = pd.get("compression_training", {})
         self.monitor_config = None  # assembled by MonitorMaster
 

@@ -664,12 +664,16 @@ class DeepSpeedEngine:
         over remote-tunnel backends — and lets XLA overlap the optimizer
         with the backward tail."""
         zc = self.config.zero_config
-        return (self.config.gradient_accumulation_steps == 1
+        return (self.config.fuse_optimizer_step
+                and self.config.gradient_accumulation_steps == 1
                 and not self._onebit
                 and self._offload_plan is None and not self._offload_device
                 and not zc.zero_quantized_gradients
                 and not (zc.zero_quantized_weights and self.zero_stage >= 3)
-                and not self.config.flops_profiler.enabled)
+                and not self.config.flops_profiler.enabled
+                # wall_clock_breakdown asks for separate fwd/step timings,
+                # which a single fused program cannot attribute
+                and not self.config.wall_clock_breakdown)
 
     def _build_fused_step(self):
         """micro (loss+grads) and optimizer apply in ONE jitted program."""
@@ -846,8 +850,16 @@ class DeepSpeedEngine:
         self.timers(STEP_MICRO_TIMER).stop(
             sync_obj=self.state["loss_scale"]
             if self.config.wall_clock_breakdown else None)
-        # Sync only at reporting boundaries: intermediate steps time dispatch
-        # but the window total stays exact, and async overlap is preserved.
+        self._post_step_bookkeeping(overflow)
+        return gnorm
+
+    def _post_step_bookkeeping(self, overflow) -> None:
+        """Shared tail of every optimizer-step flavour (standard, fused,
+        1-bit): throughput accounting, step counters, data-efficiency
+        schedules, overflow logging, lr schedule, periodic reporting."""
+        # Sync only at reporting boundaries: intermediate steps time
+        # dispatch but the window total stays exact, and async overlap is
+        # preserved.
         tput_sync = (self.config.wall_clock_breakdown
                      or (self.tput_timer.global_step_count + 1)
                      % self.tput_timer.steps_per_output == 0)
@@ -862,8 +874,9 @@ class DeepSpeedEngine:
             if bool(jax.device_get(overflow)):
                 self.skipped_steps += 1
                 log_dist(
-                    f"step {self.global_steps}: fp16 overflow, skipping update "
-                    f"(loss scale -> {float(jax.device_get(self.state['loss_scale']))})",
+                    f"step {self.global_steps}: fp16 overflow, skipping "
+                    f"update (loss scale -> "
+                    f"{float(jax.device_get(self.state['loss_scale']))})",
                     ranks=[0])
         if self.lr_scheduler is not None:
             self.lr_scheduler.step(self.global_steps)
@@ -875,8 +888,8 @@ class DeepSpeedEngine:
                 self.monitor.write_events([
                     ("Train/lr", self.get_lr()[0], self.global_steps),
                     ("Train/samples_per_sec",
-                     self.tput_timer.avg_samples_per_sec(), self.global_steps)])
-        return gnorm
+                     self.tput_timer.avg_samples_per_sec(),
+                     self.global_steps)])
 
     def _maybe_profile_flops(self):
         """One-shot compiler-derived flops profile at ``profile_step``
@@ -938,33 +951,7 @@ class DeepSpeedEngine:
         the fused forward program."""
         gnorm, overflow = self._pending_step
         self._pending_step = None
-        tput_sync = (self.config.wall_clock_breakdown
-                     or (self.tput_timer.global_step_count + 1)
-                     % self.tput_timer.steps_per_output == 0)
-        self.tput_timer.stop(
-            global_step=True,
-            sync_obj=self.state["loss_scale"] if tput_sync else None)
-        self.global_steps += 1
-        self._update_data_efficiency()
-        if self.fp16_enabled and bool(jax.device_get(overflow)):
-            self.skipped_steps += 1
-            log_dist(
-                f"step {self.global_steps}: fp16 overflow, skipping update "
-                f"(loss scale -> "
-                f"{float(jax.device_get(self.state['loss_scale']))})",
-                ranks=[0])
-        if self.lr_scheduler is not None:
-            self.lr_scheduler.step(self.global_steps)
-        if self.global_steps % self.config.steps_per_print == 0:
-            if self.config.wall_clock_breakdown:
-                self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER],
-                                memory_breakdown=True)
-            if self.monitor.enabled:
-                self.monitor.write_events([
-                    ("Train/lr", self.get_lr()[0], self.global_steps),
-                    ("Train/samples_per_sec",
-                     self.tput_timer.avg_samples_per_sec(),
-                     self.global_steps)])
+        self._post_step_bookkeeping(overflow)
         return gnorm
 
     def _onebit_compression_stage(self) -> bool:
@@ -995,29 +982,7 @@ class DeepSpeedEngine:
         self.timers(STEP_MICRO_TIMER).stop(
             sync_obj=self.state["loss_scale"]
             if self.config.wall_clock_breakdown else None)
-        self.tput_timer.stop(global_step=True, sync_obj=None)
-        self.global_steps += 1
-        self._update_data_efficiency()
-        self._maybe_profile_flops()
-        if self.fp16_enabled and bool(jax.device_get(overflow)):
-            self.skipped_steps += 1
-            log_dist(
-                f"step {self.global_steps}: fp16 overflow in 1-bit apply, "
-                f"skipping update (loss scale -> "
-                f"{float(jax.device_get(self.state['loss_scale']))})",
-                ranks=[0])
-        if self.lr_scheduler is not None:
-            self.lr_scheduler.step(self.global_steps)
-        if self.global_steps % self.config.steps_per_print == 0:
-            if self.config.wall_clock_breakdown:
-                self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER],
-                                memory_breakdown=True)
-            if self.monitor.enabled:
-                self.monitor.write_events([
-                    ("Train/lr", self.get_lr()[0], self.global_steps),
-                    ("Train/samples_per_sec",
-                     self.tput_timer.avg_samples_per_sec(),
-                     self.global_steps)])
+        self._post_step_bookkeeping(overflow)
         return gnorm
 
     def train(self, mode: bool = True):
@@ -1070,6 +1035,14 @@ class DeepSpeedEngine:
                         save_latest: bool = True):
         from deepspeed_tpu.checkpoint.engine import save_engine_state
 
+        if self._pending_step is not None:
+            # the fused forward already applied the optimizer update; a
+            # checkpoint here would persist weights one step ahead of the
+            # global_steps/lr bookkeeping
+            raise RuntimeError(
+                "save_checkpoint called between forward() and step() with "
+                "the fused step active: call step() first so the "
+                "engine's step/lr bookkeeping matches the saved weights")
         tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
         client_state.update({

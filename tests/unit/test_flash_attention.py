@@ -131,3 +131,52 @@ def test_op_builder_flash_entry():
 
     fn = get_op_builder("flash_attn").load()
     assert fn is flash_attention
+
+
+def test_flash_sliding_window_matches_banded_xla():
+    """Window as a kernel argument == XLA banded-mask attention, fwd+bwd."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.ops.attention import _xla_attention
+    from deepspeed_tpu.ops.flash_attention import flash_attention
+
+    b, s, h, d = 2, 256, 4, 32
+    window = 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.float32)
+
+    def f_kernel(q, k, v):
+        return flash_attention(q, k, v, causal=True, window=window,
+                               block_q=64, block_k=64).sum()
+
+    def f_ref(q, k, v):
+        return _xla_attention(q, k, v, causal=True, mask=None, scale=None,
+                              window=window).sum()
+
+    out_k = flash_attention(q, k, v, causal=True, window=window,
+                            block_q=64, block_k=64)
+    out_r = _xla_attention(q, k, v, causal=True, mask=None, scale=None,
+                           window=window)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                               rtol=2e-5, atol=2e-5)
+    gk = jax.grad(f_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, bb in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_window_requires_causal():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from deepspeed_tpu.ops.flash_attention import flash_attention
+
+    q = jnp.zeros((1, 128, 2, 32))
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, q, q, causal=False, window=16)
