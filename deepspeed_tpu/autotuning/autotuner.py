@@ -164,15 +164,17 @@ class Autotuner:
         from deepspeed_tpu.parallel import groups
 
         try:
+            run_config = exp.config
             if self.fast:
                 # fast mode inspects the micro program's cost analysis, so
-                # keep micro/apply as separate programs
-                exp.config["fuse_optimizer_step"] = False
+                # keep micro/apply split FOR THE TRIAL ONLY — the recorded
+                # / returned config must not carry the override
+                run_config = {**exp.config, "fuse_optimizer_step": False}
             engine, _, _, _ = deepspeed_tpu.initialize(
-                model=self.model, config=exp.config,
+                model=self.model, config=run_config,
                 topology=groups.get_topology())
             args = self.sample_batch_fn(
-                exp.config["train_micro_batch_size_per_gpu"] *
+                run_config["train_micro_batch_size_per_gpu"] *
                 engine.dp_world_size)
             if self.fast:
                 # compiler cost model: roofline step-time estimate

@@ -178,9 +178,14 @@ class LlamaAttention(nn.Module):
             new_cache = {"k": ck, "v": cv}
             if x.shape[1] > 1 and isinstance(cache_index, int) \
                     and cache_index == 0:
-                # prefill from an empty cache: plain causal attention over
-                # the fresh k/v — flash-kernel eligible (no mask needed)
-                out = attn(q, k, v, causal=True)
+                # prefill from an empty cache: causal attention over the
+                # fresh k/v — flash-kernel eligible (window included)
+                if cfg.sliding_window is not None and \
+                        x.shape[1] > cfg.sliding_window:
+                    out = attn(q, k, v, causal=True,
+                               window=cfg.sliding_window)
+                else:
+                    out = attn(q, k, v, causal=True)
             else:
                 # incremental decode: attend over the cache with a validity
                 # mask (key_pos <= query_pos)

@@ -11,11 +11,6 @@ the same window.
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Any
-
-import jax.numpy as jnp
-
 from deepspeed_tpu.models.llama import (
     LLAMA_PARTITION_RULES,
     LlamaConfig,

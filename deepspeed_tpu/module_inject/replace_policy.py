@@ -1,0 +1,122 @@
+"""Per-architecture injection policies (reference:
+module_inject/replace_policy.py + containers/{bert,bloom,gpt2,gptj,
+gptneo,gptneox,llama,llama2,opt,megatron,distil_bert,internlm,clip}.py —
+each policy maps a model family's weight names to the TP slicing plan).
+
+TPU form: a policy is a list of ``(regex, PartitionSpec)`` rules over
+'/'-joined param paths (the same language the engine, AutoTP, and the
+inference engine consume). ``replace_module`` resolves a policy by
+architecture name (or falls back to AutoTP's structural parser) and
+returns the sharding rules — the "replacement" the reference performs by
+swapping CUDA modules is, on TPU, purely a sharding assignment that GSPMD
+compiles into row/column-parallel matmuls with the correct all-reduces
+(auto_tp.py:317 ``_replace`` analog).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.gpt2 import GPT2_PARTITION_RULES
+from deepspeed_tpu.models.llama import LLAMA_PARTITION_RULES
+from deepspeed_tpu.models.opt import OPT_PARTITION_RULES
+
+# column-parallel = shard output dim; row-parallel = shard input dim
+# (all-reduce after), embeddings vocab-parallel — reference containers'
+# attention_qkvw / mlp inter vs attention_ow / mlp output split.
+POLICY_REGISTRY: Dict[str, List[Tuple[str, Any]]] = {}
+
+
+def register_policy(name: str, rules: List[Tuple[str, Any]]) -> None:
+    POLICY_REGISTRY[name.lower()] = rules
+
+
+# single source of truth: the model modules own their rules
+register_policy("llama", LLAMA_PARTITION_RULES)
+register_policy("llama2", POLICY_REGISTRY["llama"])
+register_policy("mistral", POLICY_REGISTRY["llama"])
+register_policy("internlm", POLICY_REGISTRY["llama"])
+
+register_policy("mixtral", POLICY_REGISTRY["llama"] + [
+    (r"experts.*(w1|w3)/kernel", P(None, "model")),
+    (r"experts.*w2/kernel", P("model", None)),
+    (r"gate/kernel", P()),
+])
+
+register_policy("gpt2", GPT2_PARTITION_RULES)
+register_policy("megatron", POLICY_REGISTRY["gpt2"])
+
+register_policy("opt", OPT_PARTITION_RULES)
+
+register_policy("bloom", [
+    (r"word_embeddings/embedding", P("model", None)),
+    (r"query_key_value/kernel", P(None, "model")),
+    (r"attention/dense/kernel", P("model", None)),
+    (r"dense_h_to_4h/kernel", P(None, "model")),
+    (r"dense_4h_to_h/kernel", P("model", None)),
+    (r".*layernorm.*", P()),
+])
+register_policy("gptneox", POLICY_REGISTRY["bloom"])
+
+register_policy("gptj", [
+    (r"wte/embedding", P("model", None)),
+    (r"(q_proj|k_proj|v_proj)/kernel", P(None, "model")),
+    (r"out_proj/kernel", P("model", None)),
+    (r"fc_in/kernel", P(None, "model")),
+    (r"fc_out/kernel", P("model", None)),
+    (r".*ln.*", P()),
+])
+register_policy("gptneo", POLICY_REGISTRY["gptj"])
+
+register_policy("bert", [
+    (r"word_embeddings/embedding", P("model", None)),
+    (r"(query|key|value)/kernel", P(None, "model")),
+    (r"attention/output/dense/kernel", P("model", None)),
+    (r"intermediate/dense/kernel", P(None, "model")),
+    (r"(?<!attention/)output/dense/kernel", P("model", None)),
+    (r".*layer_?norm.*", P()),
+    (r"pooler/dense/kernel", P()),
+])
+register_policy("distilbert", POLICY_REGISTRY["bert"])
+
+
+def policy_for(architecture: str) -> Optional[List[Tuple[str, Any]]]:
+    """Rules for an architecture name (case-insensitive; accepts HF-style
+    class names like 'LlamaForCausalLM')."""
+    key = architecture.lower()
+    if key in POLICY_REGISTRY:
+        return POLICY_REGISTRY[key]
+    for name in sorted(POLICY_REGISTRY, key=len, reverse=True):
+        if name in key:
+            return POLICY_REGISTRY[name]
+    return None
+
+
+def replace_module(model=None, params_or_shapes=None,
+                   architecture: Optional[str] = None,
+                   checkpoint=None, **_kwargs):
+    """reference replace_module:557 — resolve the TP plan for a model.
+
+    Returns ``(regex, PartitionSpec)`` rules: from the model's own
+    ``partition_rules`` if present, else the registered policy for
+    ``architecture`` (or the model's class name), else AutoTP's
+    structural parse of the param tree.
+    """
+    rules = getattr(model, "partition_rules", None)
+    if rules is not None:
+        return rules
+    arch = architecture or (type(model).__name__ if model is not None
+                            else "")
+    rules = policy_for(arch) if arch else None
+    if rules is not None:
+        return rules
+    if params_or_shapes is None:
+        raise ValueError(
+            f"no policy for architecture {arch!r} and no params to parse; "
+            f"register one with register_policy() or pass params for "
+            f"AutoTP")
+    from deepspeed_tpu.module_inject.auto_tp import tp_parser
+
+    return tp_parser(params_or_shapes)

@@ -7,3 +7,9 @@ from deepspeed_tpu.sequence.layer import (
 
 __all__ = ["DistributedAttention", "SeqAllToAll", "seq_all_to_all",
            "ulysses_attention"]
+from deepspeed_tpu.sequence.ring_attention import (
+    DistributedRingAttention,
+    ring_attention,
+)
+
+__all__ += ["DistributedRingAttention", "ring_attention"]

@@ -146,3 +146,29 @@ def test_engine_configures_activation_checkpointing():
     deepspeed_tpu.initialize(model=(m.init, m.apply), config=cfg)
     assert checkpointing.is_configured()
     checkpointing.reset()
+
+
+def test_replace_policy_registry():
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.module_inject.replace_policy import (
+        POLICY_REGISTRY, policy_for, replace_module)
+
+    assert {"llama", "gpt2", "opt", "bloom", "gptj", "bert",
+            "mixtral"} <= set(POLICY_REGISTRY)
+    # HF-style class names resolve
+    assert policy_for("LlamaForCausalLM") is POLICY_REGISTRY["llama"]
+    assert policy_for("BloomForCausalLM") is POLICY_REGISTRY["bloom"]
+    assert policy_for("NoSuchArch") is None
+    # model-provided rules win
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    assert replace_module(m) == m.partition_rules
+    # unknown arch + params falls back to AutoTP's structural parse
+    import jax.numpy as jnp
+
+    rules = replace_module(params_or_shapes={"up_proj": {
+        "kernel": jnp.zeros((8, 16))}}, architecture="mystery")
+    assert rules  # AutoTP recognises the column-parallel projection
+
+
+def test_ring_attention_exported():
+    from deepspeed_tpu.sequence import DistributedRingAttention, ring_attention  # noqa: F401
