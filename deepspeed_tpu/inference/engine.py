@@ -75,6 +75,33 @@ def _bucket(n: int) -> int:
     return max(BUCKET, ((n + BUCKET - 1) // BUCKET) * BUCKET)
 
 
+class _DequantizingModule:
+    """Module proxy for weight-only quantized inference (reference
+    inference/quantization/ ZeroQuant path + module_inject
+    ``GroupQuantizer:43``): params live in HBM as int8 groupwise records;
+    ``apply`` dequantizes to compute precision in-graph (XLA fuses the
+    dequant into the consuming matmuls, so the resident footprint is the
+    int8 tree)."""
+
+    def __init__(self, module, weight_quantizer, compute_dtype):
+        self._mod = module
+        self._wq = weight_quantizer
+        self._dtype = compute_dtype
+
+    def apply(self, variables, *args, **kwargs):
+        params = self._wq.dequantize_tree(variables["params"],
+                                          dtype=self._dtype)
+        return self._mod.apply({"params": params}, *args, **kwargs)
+
+    def init(self, *args, **kwargs):
+        return self._mod.init(*args, **kwargs)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):  # avoid recursion pre-__init__ (pickle)
+            raise AttributeError(name)
+        return getattr(self._mod, name)
+
+
 class InferenceEngine:
     """TP-sharded, KV-cached generation engine."""
 
@@ -101,6 +128,29 @@ class InferenceEngine:
         self.topology = topology
         self.mesh = topology.mesh
         self.mp_world_size = topology.model_parallel_size
+
+        # weight-only quantization (reference init_inference quant config)
+        self._weight_quantizer = None
+        qcfg = self.config.quant if isinstance(self.config.quant, dict) \
+            else {}
+        if qcfg.get("enabled", False):
+            from deepspeed_tpu.runtime.weight_quantizer import (
+                WeightQuantization)
+
+            if self.topology.model_parallel_size > 1:
+                raise NotImplementedError(
+                    "weight-quantized inference currently requires tp=1 "
+                    "(quantized records are not TP-sliced yet)")
+            self._weight_quantizer = WeightQuantization(
+                quantize_bits=int(qcfg.get("num_bits", 8)),
+                quantize_groups=int(qcfg.get("num_groups", 64)))
+            model = _DequantizingModule(model, self._weight_quantizer,
+                                        self.dtype)
+            self.module = model
+            log_dist(
+                f"InferenceEngine: weight-only int"
+                f"{self._weight_quantizer.quantize_bits} quantization on",
+                ranks=[0])
 
         self._init_cache_fn = init_cache_fn or self._default_cache_fn()
         self._rules = base_param_specs \
@@ -141,7 +191,6 @@ class InferenceEngine:
         """Cast + place each leaf individually so no device materialises the
         full unsharded tree (reference loads per-rank slices,
         engine.py:331 load_model_with_checkpoint)."""
-        slicer = self._param_sharding(host_params)
         dtype = self.dtype
 
         def cast(x):
@@ -150,6 +199,19 @@ class InferenceEngine:
                 return x.astype(dtype)
             return x
 
+        if self._weight_quantizer is not None:
+            # quantize matrices; everything else still gets the dtype cast
+            qtree, count = self._weight_quantizer.model_quantize(
+                jax.tree.map(jnp.asarray, host_params))
+            log_dist(f"InferenceEngine: quantized {count} weight matrices",
+                     ranks=[0])
+            is_rec = self._weight_quantizer.is_quantized_record
+            self.params = jax.tree.map(
+                lambda leaf: (jax.tree.map(jax.device_put, leaf) if
+                              is_rec(leaf) else jax.device_put(cast(leaf))),
+                qtree, is_leaf=is_rec)
+            return
+        slicer = self._param_sharding(host_params)
         self.params = slicer.shard_tree(jax.tree.map(cast, host_params))
 
     def init_parameters(self, sample_ids, seed: Optional[int] = None):
@@ -164,6 +226,11 @@ class InferenceEngine:
         self.params = jax.jit(
             lambda r: self.module.init(r, sample_ids)["params"],
             out_shardings=shardings)(rng)
+        if self._weight_quantizer is not None:
+            self.params, count = self._weight_quantizer.model_quantize(
+                self.params)
+            log_dist(f"InferenceEngine: quantized {count} weight matrices",
+                     ranks=[0])
         return self.params
 
     def _ensure_params(self, ids):

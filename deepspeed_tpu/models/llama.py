@@ -159,15 +159,18 @@ class LlamaAttention(nn.Module):
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
         attn = attention_fn or dot_product_attention
-        if cache is None:
+
+        def prefill_attn(q_, k_, v_):
+            # Mistral SWA: the window is a first-class kernel argument
+            # (flash path skips out-of-band k-blocks; no dense mask)
             if cfg.sliding_window is not None and \
-                    x.shape[1] > cfg.sliding_window:
-                # Mistral SWA: the window is a first-class kernel argument
-                # (flash path skips out-of-band k-blocks; no dense mask)
-                out = attn(q, k, v, causal=True,
-                           window=cfg.sliding_window)
-            else:
-                out = attn(q, k, v, causal=True)
+                    q_.shape[1] > cfg.sliding_window:
+                return attn(q_, k_, v_, causal=True,
+                            window=cfg.sliding_window)
+            return attn(q_, k_, v_, causal=True)
+
+        if cache is None:
+            out = prefill_attn(q, k, v)
             new_cache = None
         else:
             # write the new keys/values at cache_index
@@ -180,12 +183,7 @@ class LlamaAttention(nn.Module):
                     and cache_index == 0:
                 # prefill from an empty cache: causal attention over the
                 # fresh k/v — flash-kernel eligible (window included)
-                if cfg.sliding_window is not None and \
-                        x.shape[1] > cfg.sliding_window:
-                    out = attn(q, k, v, causal=True,
-                               window=cfg.sliding_window)
-                else:
-                    out = attn(q, k, v, causal=True)
+                out = prefill_attn(q, k, v)
             else:
                 # incremental decode: attend over the cache with a validity
                 # mask (key_pos <= query_pos)

@@ -3,10 +3,11 @@ runtime/weight_quantizer.py ``WeightQuantization`` + runtime/quantize.py —
 groupwise int8/int4 of transformer weights before module injection).
 
 Built on the kernel layer (:mod:`deepspeed_tpu.ops.quantizer`): each leaf
-is quantized groupwise; ``model_quantize`` walks a param
-tree and replaces selected 2D+ leaves with (q, scale) records, and
-``dequantize_tree`` restores compute-precision weights (the
-dequant-on-load path the inference engine uses).
+is quantized groupwise; ``model_quantize`` walks a param tree and replaces
+selected 2D+ leaves with ``{"q": int8 array in the weight's shape,
+"scale": [groups] fp32}`` records (all-array, so they flow through jit as
+plain pytrees), and ``dequantize_tree`` restores compute-precision weights
+(the dequant-on-use path the inference engine fuses into its matmuls).
 """
 
 from __future__ import annotations
@@ -35,16 +36,19 @@ class WeightQuantization:
 
     def quantize_leaf(self, w: jnp.ndarray, groups: int
                       ) -> Dict[str, jnp.ndarray]:
+        """Record = {q: int8 in the WEIGHT'S shape, scale: [groups]} —
+        all-array records flow through jit as plain pytrees (the original
+        shape travels with q itself)."""
         n = int(np.prod(w.shape))
         while n % groups != 0:
             groups //= 2
         q, scale, _ = quantize(w, max(groups, 1), self.quantize_bits, True)
-        return {"q": q, "scale": scale, "shape": w.shape}
+        return {"q": q.reshape(w.shape), "scale": scale}
 
     def model_quantize(self, params: Any, min_size: int = 1024
                        ) -> Tuple[Any, int]:
         """Quantize every matrix leaf with >= min_size elements. Returns
-        (tree with {q, scale, shape} records, count quantized)."""
+        (tree with {q, scale} records, count quantized)."""
         count = 0
 
         def one(path, leaf):
@@ -61,15 +65,20 @@ class WeightQuantization:
 
     @staticmethod
     def is_quantized_record(leaf) -> bool:
-        return isinstance(leaf, dict) and set(leaf) == {"q", "scale",
-                                                        "shape"}
+        # key set AND int8 payload: a model's own {'q','scale'} param
+        # subtree (fp32 weights) must not be mistaken for a record
+        return (isinstance(leaf, dict) and set(leaf) == {"q", "scale"}
+                and getattr(leaf["q"], "dtype", None) == jnp.int8)
 
     def dequantize_tree(self, tree: Any, dtype=jnp.bfloat16) -> Any:
         def one(leaf):
             if self.is_quantized_record(leaf):
-                return dequantize(leaf["q"], leaf["scale"],
+                shape = leaf["q"].shape
+                groups = leaf["scale"].shape[0]
+                return dequantize(leaf["q"].reshape(groups, -1),
+                                  leaf["scale"],
                                   num_bits=self.quantize_bits,
-                                  dtype=dtype).reshape(leaf["shape"])
+                                  dtype=dtype).reshape(shape)
             return leaf
 
         return jax.tree.map(one, tree,

@@ -106,6 +106,11 @@ class DistributedRingAttention:
                  scatter_idx: int = 1,  # sequence dim (API parity)
                  gather_idx: int = 1,
                  sequence_axis: str = "seq"):
+        if scatter_idx != 1 or gather_idx != 1:
+            raise NotImplementedError(
+                "ring attention shards the sequence dim (idx 1) only; "
+                "head-scatter layouts belong to DistributedAttention "
+                "(Ulysses)")
         self.causal = causal
         self.sequence_axis = sequence_axis
 
