@@ -200,16 +200,29 @@ class InferenceEngine:
             return x
 
         if self._weight_quantizer is not None:
-            # quantize matrices; everything else still gets the dtype cast
-            qtree, count = self._weight_quantizer.model_quantize(
-                jax.tree.map(jnp.asarray, host_params))
+            # leaf-by-leaf from host: each matrix is quantized and only the
+            # int8 record lands in HBM — the full-precision tree is never
+            # device-resident (the point of weight-only serving)
+            wq = self._weight_quantizer
+            count = 0
+            flat, treedef = jax.tree_util.tree_flatten_with_path(host_params)
+            placed_leaves = []
+            for path, leaf in flat:
+                arr = np.asarray(leaf)
+                if arr.ndim >= 2 and arr.size >= 1024:
+                    name = "/".join(
+                        str(getattr(kk, "key", getattr(kk, "idx", kk)))
+                        for kk in path)
+                    rec = wq.quantize_leaf(jnp.asarray(arr),
+                                           wq._groups_for(name))
+                    placed_leaves.append(jax.tree.map(jax.device_put, rec))
+                    count += 1
+                else:
+                    placed_leaves.append(jax.device_put(cast(arr)))
             log_dist(f"InferenceEngine: quantized {count} weight matrices",
                      ranks=[0])
-            is_rec = self._weight_quantizer.is_quantized_record
-            self.params = jax.tree.map(
-                lambda leaf: (jax.tree.map(jax.device_put, leaf) if
-                              is_rec(leaf) else jax.device_put(cast(leaf))),
-                qtree, is_leaf=is_rec)
+            self.params = jax.tree_util.tree_unflatten(treedef,
+                                                       placed_leaves)
             return
         slicer = self._param_sharding(host_params)
         self.params = slicer.shard_tree(jax.tree.map(cast, host_params))

@@ -287,6 +287,7 @@ class DeepSpeedEngine:
         self._jit_apply: Optional[Callable] = None
         self._jit_eval: Optional[Callable] = None
         self._jit_fused: Optional[Callable] = None
+        self._jit_train_batch: Optional[Callable] = None
         self._pending_step = None  # (gnorm, overflow) from a fused forward
         self._micro_compiled = None  # AOT executables (flops profiler path)
         self._apply_compiled = None
@@ -701,6 +702,112 @@ class DeepSpeedEngine:
             fused,
             donate_argnums=(0,),
             out_shardings=(dict(sh), scalar, scalar, scalar))
+
+    def _build_train_batch(self):
+        """One jitted program for a FULL training batch: ``lax.scan`` over
+        the gradient-accumulation micro-batches, then the optimizer apply
+        (reference ``train_batch`` semantics, pipe/engine.py:321, here for
+        the dense engine). One dispatch per optimizer step regardless of
+        gas — the scan body is traced once."""
+        sh = self._state_shardings()
+        gas = int(self.config.gradient_accumulation_steps)
+        apply_step = self._make_apply_step()
+
+        def run(state, lr, rngs, *args):
+            # args leaves: [gas, micro_global, ...] — dim 1 dp-sharded
+            def micro_body(carry, sl):
+                acc = carry
+                rng_i = sl[0]
+                batch = sl[1:]
+
+                def scaled_loss_fn(p):
+                    out = self._apply_fn(p, *batch, rng=rng_i, train=True)
+                    loss, _aux = self._loss_from_outputs(out, batch)
+                    return loss.astype(jnp.float32) * \
+                        (state["loss_scale"] / gas), loss
+
+                (_, loss), grads = jax.value_and_grad(
+                    scaled_loss_fn, has_aux=True)(state["params"])
+                acc = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                return acc, loss
+
+            acc, losses = jax.lax.scan(
+                micro_body, state["acc_grads"], (rngs,) + args)
+            new_state, gnorm, overflow = apply_step(
+                {**state, "acc_grads": acc}, lr)
+            return new_state, jnp.mean(losses), gnorm, overflow
+
+        scalar = NamedSharding(self.mesh, P())
+        self._jit_train_batch = jax.jit(
+            run, donate_argnums=(0,),
+            out_shardings=(dict(sh), scalar, scalar, scalar))
+
+    def train_batch(self, data_iter=None, data=None, batch=None):
+        """Reference ``train_batch`` surface (``data_iter``/``data`` match
+        PipelineEngine.train_batch): consume ``gas`` micro-batches — from
+        ``data_iter``, or pre-stacked arrays (leading gas dim) via
+        ``data``/``batch`` — run them and the optimizer step as ONE
+        compiled program, and return the mean loss.
+
+        Falls back to the fwd/bwd/step loop for engines whose micro path
+        is specialised (1-bit, ZeRO++ quantized, offload transfers).
+        """
+        gas = int(self.config.gradient_accumulation_steps)
+        if self.micro_steps % gas != 0:
+            raise RuntimeError(
+                f"train_batch called mid-accumulation (micro_steps="
+                f"{self.micro_steps}, gas={gas}): finish the pending "
+                f"forward/backward/step sequence first")
+        if batch is None:
+            batch = data
+        if batch is None:
+            if data_iter is None:
+                raise ValueError("train_batch needs data_iter or batch")
+            micros = [next(data_iter) for _ in range(gas)]
+            micros = [m if isinstance(m, (tuple, list)) else (m,)
+                      for m in micros]
+            batch = tuple(
+                np.stack([np.asarray(m[i]) for m in micros])
+                for i in range(len(micros[0])))
+        if self._onebit or self._offload_plan is not None or \
+                self.config.zero_config.zero_quantized_gradients or \
+                (self.config.zero_config.zero_quantized_weights and
+                 self.zero_stage >= 3):
+            losses = []
+            for g in range(gas):
+                sl = tuple(leaf[g] for leaf in batch)
+                loss = self.forward(*sl)
+                self.backward(loss)
+                self.step()
+                losses.append(loss)
+            return jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
+        if self.state is None:
+            self.initialize_parameters(*(leaf[0] for leaf in batch))
+        if self._jit_train_batch is None:
+            self._build_train_batch()
+        def place(leaf):
+            # micro-batch sharding (honours a custom batch_spec) with a
+            # replicated leading gas axis
+            if getattr(leaf, "ndim", 0) < 2:
+                return jax.device_put(leaf, NamedSharding(self.mesh, P()))
+            micro_sharding = self.batch_sharding(leaf[0])
+            spec = P(None, *tuple(micro_sharding.spec))
+            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+
+        placed = tuple(place(leaf) for leaf in batch)
+        self._rng, sub = jax.random.split(self._rng)
+        rngs = jax.random.split(sub, gas)
+        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        self.tput_timer.start()
+        self.state, loss, gnorm, overflow = self._jit_train_batch(
+            self.state, lr, rngs, *placed)
+        self._last_loss = loss
+        self.micro_steps += gas
+        self.global_samples += self.config.train_micro_batch_size_per_gpu \
+            * self.dp_world_size * gas
+        self._post_step_bookkeeping(overflow)
+        return loss
 
     def _build_eval(self):
         def ev(params, rng, *args):
