@@ -209,12 +209,10 @@ class InferenceEngine:
             placed_leaves = []
             for path, leaf in flat:
                 arr = np.asarray(leaf)
-                if arr.ndim >= 2 and arr.size >= 1024:
-                    name = "/".join(
-                        str(getattr(kk, "key", getattr(kk, "idx", kk)))
-                        for kk in path)
-                    rec = wq.quantize_leaf(jnp.asarray(arr),
-                                           wq._groups_for(name))
+                if wq.should_quantize(arr):
+                    rec = wq.quantize_leaf(
+                        jnp.asarray(arr),
+                        wq.groups_for(wq.leaf_name(path)))
                     placed_leaves.append(jax.tree.map(jax.device_put, rec))
                     count += 1
                 else:

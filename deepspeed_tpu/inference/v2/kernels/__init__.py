@@ -1,0 +1,8 @@
+"""Ragged inference kernels (reference: inference/v2/kernels/ragged_ops/)."""
+
+from deepspeed_tpu.inference.v2.kernels.blocked_flash import (
+    paged_attention,
+    paged_attention_usable,
+)
+
+__all__ = ["paged_attention", "paged_attention_usable"]

@@ -1,0 +1,151 @@
+"""Paged flash attention over the blocked KV pool (reference:
+inference/v2/kernels/ragged_ops/blocked_flash/ — flash attention whose KV
+comes from paged "atoms" resolved through per-sequence block tables,
+``atom_builder`` + ``blocked_flash``).
+
+Pallas TPU kernel using scalar prefetch: the ragged metadata
+(``token_slot``, ``token_pos``, ``block_tables``) rides in SMEM and DRIVES
+THE BLOCK SPEC INDEX MAPS, so each grid step DMAs exactly the KV pool
+block the current token's block table names — no per-token context gather
+is ever materialised (the XLA reference path builds a [T, C, Hkv, D]
+gather; this kernel's live set is one [block_size, Hkv, D] block plus the
+accumulators).
+
+Grid: (tokens, blocks_per_sequence); the block axis is innermost and
+sequential on TPU, so fp32 online-softmax accumulators live in VMEM
+scratch across it (same structure as ops/flash_attention.py). Invalid
+table slots (past a sequence's length) are masked by position — their DMA
+reads whatever block the table names (0 for never-written rows), and the
+mask discards it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(token_slot, token_pos, tables, q_ref, k_ref, v_ref, o_ref,
+            acc_ref, m_ref, l_ref, *, block_size, num_blocks_per_seq,
+            scale):
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    pos = token_pos[t]
+    # skip blocks entirely past this token's position
+    run = j * block_size <= pos
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0].astype(jnp.float32)              # [H, D]
+        k = k_ref[0].astype(jnp.float32)              # [bs, Hkv, D]
+        v = v_ref[0].astype(jnp.float32)
+        h = q.shape[0]
+        hkv = k.shape[1]
+        g = h // hkv
+        qg = q.reshape(hkv, g, q.shape[1])            # [Hkv, g, D]
+        # scores per kv head: [Hkv, g, bs]
+        s = jax.lax.dot_general(
+            qg, k.transpose(1, 2, 0), (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        key_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (hkv, g, block_size), 2)
+        s = jnp.where(key_pos <= pos, s, NEG_INF)
+
+        sh = s.reshape(h, block_size)
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(sh, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(sh - m_new)                       # [H, bs]
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+            l_ref.shape)
+        pg = p.reshape(hkv, g, block_size)
+        out = jax.lax.dot_general(
+            pg, v.transpose(1, 0, 2), (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # [Hkv, g, D]
+        acc_ref[:] = acc_ref[:] * corr + out.reshape(h, -1)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == num_blocks_per_seq - 1)
+    def _():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def paged_attention_usable(q, k_pool, block_size: int) -> bool:
+    h, d = q.shape[1], q.shape[2]
+    hkv = k_pool.shape[1]
+    return (h % hkv == 0 and d % 8 == 0 and block_size % 8 == 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_size", "interpret"))
+def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                    v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                    token_slot: jnp.ndarray, token_pos: jnp.ndarray,
+                    *, block_size: int,
+                    interpret: Any = None) -> jnp.ndarray:
+    """q: [T, H, D]; k/v_pool: [num_blocks*block_size, Hkv, D];
+    block_tables: [S, B] int32; token_slot/token_pos: [T] int32.
+    Returns [T, H, D] — each token attends over its sequence's paged
+    context up to its own position.
+    """
+    t_count, h, d = q.shape
+    hkv = k_pool.shape[1]
+    nb = k_pool.shape[0] // block_size
+    s_count, b_per_seq = block_tables.shape
+    if interpret is None:
+        try:
+            interpret = jax.devices()[0].platform != "tpu"
+        except Exception:  # noqa: BLE001
+            interpret = True
+
+    kp = k_pool.reshape(nb, block_size, hkv, d)
+    vp = v_pool.reshape(nb, block_size, hkv, d)
+    scale = 1.0 / (d ** 0.5)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(t_count, b_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, h, d),
+                         lambda t, j, slot, pos, tab: (t, 0, 0)),
+            pl.BlockSpec((1, block_size, hkv, d),
+                         lambda t, j, slot, pos, tab:
+                         (tab[slot[t], j], 0, 0, 0)),
+            pl.BlockSpec((1, block_size, hkv, d),
+                         lambda t, j, slot, pos, tab:
+                         (tab[slot[t], j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d),
+                               lambda t, j, slot, pos, tab: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_kernel, block_size=block_size,
+                               num_blocks_per_seq=b_per_seq, scale=scale)
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t_count, h, d), q.dtype),
+        interpret=bool(interpret),
+    )(token_slot.astype(jnp.int32), token_pos.astype(jnp.int32),
+      block_tables.astype(jnp.int32), q, kp, vp)

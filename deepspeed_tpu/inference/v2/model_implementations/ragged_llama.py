@@ -43,12 +43,33 @@ def _rms_norm(x, scale, eps):
             * scale.astype(jnp.float32)).astype(x.dtype)
 
 
-def _paged_attention(q, k_pool, v_pool, batch, block_size):
-    """XLA paged attention over the blocked KV pool.
+def _paged_attention(q, k_pool, v_pool, batch, block_size,
+                     use_kernel=None):
+    """Paged attention over the blocked KV pool.
 
     q: [T, H, D]; k_pool/v_pool: [num_blocks*bs, Hkv, D].
     Returns [T, H, D].
+
+    On TPU this routes to the Pallas blocked-flash kernel
+    (inference/v2/kernels/blocked_flash.py): block tables drive the
+    kernel's DMA schedule, so no [T, C, Hkv, D] context gather is ever
+    materialised. The XLA gather composition below is the reference/CPU
+    path.
     """
+    if use_kernel is None:
+        try:
+            use_kernel = jax.devices()[0].platform == "tpu"
+        except Exception:  # noqa: BLE001
+            use_kernel = False
+    if use_kernel:
+        from deepspeed_tpu.inference.v2.kernels import (
+            paged_attention, paged_attention_usable)
+
+        if paged_attention_usable(q, k_pool, block_size):
+            return paged_attention(
+                q, k_pool, v_pool, batch["block_tables"],
+                batch["token_slot"], batch["token_pos"],
+                block_size=block_size)
     block_tables = batch["block_tables"]          # [S, B]
     token_slot = batch["token_slot"]              # [T]
     token_pos = batch["token_pos"]                # [T]

@@ -530,6 +530,26 @@ class DeepSpeedEngine:
         program already averages over all microbatches)."""
         return float(self.config.gradient_accumulation_steps)
 
+    def _make_micro_accumulate(self):
+        """Shared closure: one micro-batch's scaled loss + gradient
+        accumulation (used by the micro program, the fused step, and
+        train_batch's scan body)."""
+        gas = self._grad_accum_divisor()
+
+        def micro_acc(params, acc_grads, scale, rng, args):
+            def scaled_loss_fn(p):
+                out = self._apply_fn(p, *args, rng=rng, train=True)
+                loss, _aux = self._loss_from_outputs(out, args)
+                return loss.astype(jnp.float32) * (scale / gas), loss
+
+            (_, loss), grads = jax.value_and_grad(
+                scaled_loss_fn, has_aux=True)(params)
+            acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                               acc_grads, grads)
+            return acc, loss
+
+        return micro_acc
+
     def _build_micro(self):
         """The micro program reads ONLY (params, acc_grads, loss_scale) —
         master weights and optimizer moments never flow through it, so with
@@ -551,20 +571,11 @@ class DeepSpeedEngine:
                 " (int8 wire format)", ranks=[0])
             self._jit_micro = build_quantized_micro(self)
             return
-        gas = self._grad_accum_divisor()
         sh = self._state_shardings()
+        micro_acc = self._make_micro_accumulate()
 
         def micro(params, acc_grads, scale, rng, *args):
-            def scaled_loss_fn(p):
-                out = self._apply_fn(p, *args, rng=rng, train=True)
-                loss, _aux = self._loss_from_outputs(out, args)
-                return loss.astype(jnp.float32) * (scale / gas), loss
-
-            grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
-            (_, loss), grads = grad_fn(params)
-            acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
-                               acc_grads, grads)
-            return acc, loss
+            return micro_acc(params, acc_grads, scale, rng, args)
 
         self._jit_micro = jax.jit(
             micro,
@@ -679,20 +690,12 @@ class DeepSpeedEngine:
     def _build_fused_step(self):
         """micro (loss+grads) and optimizer apply in ONE jitted program."""
         sh = self._state_shardings()
-        gas = self._grad_accum_divisor()
         apply_step = self._make_apply_step()
+        micro_acc = self._make_micro_accumulate()
 
         def fused(state, lr, rng, *args):
-            def scaled_loss_fn(p):
-                out = self._apply_fn(p, *args, rng=rng, train=True)
-                loss, _aux = self._loss_from_outputs(out, args)
-                return loss.astype(jnp.float32) * \
-                    (state["loss_scale"] / gas), loss
-
-            grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
-            (_, loss), grads = grad_fn(state["params"])
-            acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
-                               state["acc_grads"], grads)
+            acc, loss = micro_acc(state["params"], state["acc_grads"],
+                                  state["loss_scale"], rng, args)
             new_state, gnorm, overflow = apply_step(
                 {**state, "acc_grads": acc}, lr)
             return new_state, loss, gnorm, overflow
@@ -710,26 +713,14 @@ class DeepSpeedEngine:
         the dense engine). One dispatch per optimizer step regardless of
         gas — the scan body is traced once."""
         sh = self._state_shardings()
-        gas = int(self.config.gradient_accumulation_steps)
         apply_step = self._make_apply_step()
+        micro_acc = self._make_micro_accumulate()
 
         def run(state, lr, rngs, *args):
             # args leaves: [gas, micro_global, ...] — dim 1 dp-sharded
             def micro_body(carry, sl):
-                acc = carry
-                rng_i = sl[0]
-                batch = sl[1:]
-
-                def scaled_loss_fn(p):
-                    out = self._apply_fn(p, *batch, rng=rng_i, train=True)
-                    loss, _aux = self._loss_from_outputs(out, batch)
-                    return loss.astype(jnp.float32) * \
-                        (state["loss_scale"] / gas), loss
-
-                (_, loss), grads = jax.value_and_grad(
-                    scaled_loss_fn, has_aux=True)(state["params"])
-                acc = jax.tree.map(
-                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                acc, loss = micro_acc(state["params"], carry,
+                                      state["loss_scale"], sl[0], sl[1:])
                 return acc, loss
 
             acc, losses = jax.lax.scan(
@@ -754,10 +745,13 @@ class DeepSpeedEngine:
         is specialised (1-bit, ZeRO++ quantized, offload transfers).
         """
         gas = int(self.config.gradient_accumulation_steps)
-        if self.micro_steps % gas != 0:
+        if self.micro_steps % gas != 0 or self._pending_step is not None \
+                or (self._last_loss is not None
+                    and not self._seen_backward):
             raise RuntimeError(
                 f"train_batch called mid-accumulation (micro_steps="
-                f"{self.micro_steps}, gas={gas}): finish the pending "
+                f"{self.micro_steps}, gas={gas}, pending forward="
+                f"{not self._seen_backward}): finish the pending "
                 f"forward/backward/step sequence first")
         if batch is None:
             batch = data
@@ -770,10 +764,17 @@ class DeepSpeedEngine:
             batch = tuple(
                 np.stack([np.asarray(m[i]) for m in micros])
                 for i in range(len(micros[0])))
-        if self._onebit or self._offload_plan is not None or \
-                self.config.zero_config.zero_quantized_gradients or \
-                (self.config.zero_config.zero_quantized_weights and
-                 self.zero_stage >= 3):
+        zc = self.config.zero_config
+        scan_unsupported = (
+            self._onebit or self._offload_plan is not None
+            or bool(self._offload_device)
+            or zc.zero_quantized_gradients
+            or (zc.zero_quantized_weights and self.zero_stage >= 3)
+            # profiler/breakdown instrument the per-micro programs, which
+            # the single scanned program cannot attribute
+            or self.config.flops_profiler.enabled
+            or self.config.wall_clock_breakdown)
+        if scan_unsupported:
             losses = []
             for g in range(gas):
                 sl = tuple(leaf[g] for leaf in batch)
@@ -803,6 +804,7 @@ class DeepSpeedEngine:
         self.state, loss, gnorm, overflow = self._jit_train_batch(
             self.state, lr, rngs, *placed)
         self._last_loss = loss
+        self._seen_backward = True  # the cycle is complete, nothing pending
         self.micro_steps += gas
         self.global_samples += self.config.train_micro_batch_size_per_gpu \
             * self.dp_world_size * gas

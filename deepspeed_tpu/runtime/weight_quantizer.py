@@ -28,11 +28,27 @@ class WeightQuantization:
         self.quantize_bits = quantize_bits
         self.quantize_groups = quantize_groups
 
-    def _groups_for(self, path: str) -> int:
+    MIN_SIZE_DEFAULT = 1024
+
+    @staticmethod
+    def leaf_name(path) -> str:
+        """'/'-joined tree-path name (the format group matching uses)."""
+        return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+
+    @staticmethod
+    def should_quantize(leaf, min_size: int = MIN_SIZE_DEFAULT) -> bool:
+        """The single eligibility rule: matrices of >= min_size elements."""
+        return getattr(leaf, "ndim", 0) >= 2 and \
+            getattr(leaf, "size", 0) >= min_size
+
+    def groups_for(self, name: str) -> int:
         g = self.quantize_groups
-        if self.mlp_extra_grouping and ("mlp" in path or "ffn" in path):
+        if self.mlp_extra_grouping and ("mlp" in name or "ffn" in name):
             g *= 2  # reference doubles groups for MLP weights
         return g
+
+    _groups_for = groups_for  # backward-compat alias
 
     def quantize_leaf(self, w: jnp.ndarray, groups: int
                       ) -> Dict[str, jnp.ndarray]:
@@ -45,7 +61,8 @@ class WeightQuantization:
         q, scale, _ = quantize(w, max(groups, 1), self.quantize_bits, True)
         return {"q": q.reshape(w.shape), "scale": scale}
 
-    def model_quantize(self, params: Any, min_size: int = 1024
+    def model_quantize(self, params: Any,
+                       min_size: int = MIN_SIZE_DEFAULT
                        ) -> Tuple[Any, int]:
         """Quantize every matrix leaf with >= min_size elements. Returns
         (tree with {q, scale} records, count quantized)."""
@@ -53,12 +70,11 @@ class WeightQuantization:
 
         def one(path, leaf):
             nonlocal count
-            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                            for k in path)
-            if leaf.ndim < 2 or leaf.size < min_size:
+            if not self.should_quantize(leaf, min_size):
                 return leaf
             count += 1
-            return self.quantize_leaf(leaf, self._groups_for(name))
+            return self.quantize_leaf(leaf,
+                                      self.groups_for(self.leaf_name(path)))
 
         out = jax.tree_util.tree_map_with_path(one, params)
         return out, count

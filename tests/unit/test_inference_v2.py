@@ -204,3 +204,65 @@ def test_query_reports_state():
     q = eng.query(9)
     assert q["tracked"] and q["seen_tokens"] == 3 and q["pending_tokens"] == 0
     eng.flush([9])
+
+
+# ------------------------------------------------------------------ #
+# blocked-flash paged attention kernel (reference
+# inference/v2/kernels/ragged_ops/blocked_flash/)
+# ------------------------------------------------------------------ #
+def test_paged_attention_kernel_matches_xla_reference():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2.kernels import paged_attention
+    from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
+        _paged_attention)
+
+    rng = np.random.default_rng(7)
+    bs, nb, hkv, d, h = 8, 8, 2, 16, 8  # GQA group 4
+    k_pool = jnp.asarray(rng.normal(size=(nb * bs, hkv, d)).astype(
+        np.float32))
+    v_pool = jnp.asarray(rng.normal(size=(nb * bs, hkv, d)).astype(
+        np.float32))
+    tables = jnp.asarray([[0, 1, 2, 5], [3, 4, 0, 0]], jnp.int32)
+    token_slot = jnp.asarray([0, 1, 0, 1, 0], jnp.int32)
+    token_pos = jnp.asarray([25, 14, 7, 0, 31], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(5, h, d)).astype(np.float32))
+
+    batch = {"block_tables": tables, "token_slot": token_slot,
+             "token_pos": token_pos}
+    ref = _paged_attention(q, k_pool, v_pool, batch, bs, use_kernel=False)
+    got = paged_attention(q, k_pool, v_pool, tables, token_slot, token_pos,
+                          block_size=bs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_engine_with_kernel_path():
+    """Full put/query/flush engine run with the Pallas kernel forced on
+    (interpret mode on CPU): outputs must match the XLA-path engine."""
+    import numpy as np
+
+    import deepspeed_tpu.inference.v2.model_implementations.ragged_llama as rl
+
+    orig = rl._paged_attention
+
+    def forced(q, k_pool, v_pool, batch, block_size, use_kernel=None):
+        return orig(q, k_pool, v_pool, batch, block_size, use_kernel=True)
+
+    params = _params()
+    engine_ref = _v2_engine(params)
+    ids = np.random.default_rng(3).integers(
+        0, CFG.vocab_size, size=(12,)).astype(np.int32)
+    ref_logits = engine_ref.put([7], [ids])
+
+    rl._paged_attention = forced
+    try:
+        engine_k = _v2_engine(params)
+        k_logits = engine_k.put([7], [ids])
+    finally:
+        rl._paged_attention = orig
+    np.testing.assert_allclose(np.asarray(k_logits[7]),
+                               np.asarray(ref_logits[7]),
+                               rtol=2e-4, atol=2e-4)
