@@ -126,12 +126,19 @@ def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         in_specs=[
             pl.BlockSpec((1, h, d),
                          lambda t, j, slot, pos, tab: (t, 0, 0)),
+            # clamp past-position block indices to the token's last valid
+            # block: skipped iterations then revisit the same pool block,
+            # which the Pallas pipeline elides instead of DMAing garbage
             pl.BlockSpec((1, block_size, hkv, d),
                          lambda t, j, slot, pos, tab:
-                         (tab[slot[t], j], 0, 0, 0)),
+                         (tab[slot[t],
+                              jnp.minimum(j, pos[t] // block_size)],
+                          0, 0, 0)),
             pl.BlockSpec((1, block_size, hkv, d),
                          lambda t, j, slot, pos, tab:
-                         (tab[slot[t], j], 0, 0, 0)),
+                         (tab[slot[t],
+                              jnp.minimum(j, pos[t] // block_size)],
+                          0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, h, d),
                                lambda t, j, slot, pos, tab: (t, 0, 0)),

@@ -289,6 +289,7 @@ class DeepSpeedEngine:
         self._jit_fused: Optional[Callable] = None
         self._jit_train_batch: Optional[Callable] = None
         self._pending_step = None  # (gnorm, overflow) from a fused forward
+        self._accum_pending = False  # grads accumulated but not yet stepped
         self._micro_compiled = None  # AOT executables (flops profiler path)
         self._apply_compiled = None
         self._apply_in_shapes = None
@@ -746,6 +747,7 @@ class DeepSpeedEngine:
         """
         gas = int(self.config.gradient_accumulation_steps)
         if self.micro_steps % gas != 0 or self._pending_step is not None \
+                or self._accum_pending \
                 or (self._last_loss is not None
                     and not self._seen_backward):
             raise RuntimeError(
@@ -894,6 +896,7 @@ class DeepSpeedEngine:
         if self._seen_backward:
             raise RuntimeError("backward() called twice for one forward()")
         self._seen_backward = True
+        self._accum_pending = True
         self.micro_steps += 1
         self.global_samples += self.config.train_micro_batch_size_per_gpu * \
             self.dp_world_size
@@ -976,6 +979,7 @@ class DeepSpeedEngine:
             global_step=True,
             sync_obj=self.state["loss_scale"] if tput_sync else None)
         self.global_steps += 1
+        self._accum_pending = False
         self._update_data_efficiency()
         self._maybe_profile_flops()
         if self.fp16_enabled:

@@ -48,7 +48,6 @@ class WeightQuantization:
             g *= 2  # reference doubles groups for MLP weights
         return g
 
-    _groups_for = groups_for  # backward-compat alias
 
     def quantize_leaf(self, w: jnp.ndarray, groups: int
                       ) -> Dict[str, jnp.ndarray]:
