@@ -67,6 +67,9 @@ def parse_args(args=None):
     parser.add_argument("--elastic_training", action="store_true")
     parser.add_argument("--min_elastic_nodes", type=int, default=-1)
     parser.add_argument("--max_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--max_restarts", type=int, default=3,
+                        help="elastic: relaunch attempts after a failed "
+                        "worker group (reference DSElasticAgent restarts)")
     parser.add_argument("--save_pid", action="store_true")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -214,13 +217,15 @@ def build_multinode_cmds(args, world_info: Dict[str, List[int]],
 # ------------------------------------------------------------------ #
 # main
 # ------------------------------------------------------------------ #
-def main(args=None) -> int:
-    args = parse_args(args)
+def _resolve_world(args) -> Dict[str, List[int]]:
+    """Hostfile -> filtered {host: slots}, re-read per call so an elastic
+    restart picks up membership changes (dead hosts removed from the
+    hostfile by the operator/scheduler)."""
     pool = fetch_hostfile(args.hostfile)
-
     if pool is None:  # local machine only
         n = args.num_gpus if args.num_gpus > 0 else 1
-        world_info = {"localhost": list(range(n))}
+        world_info: Dict[str, List[int]] = OrderedDict(
+            [("localhost", list(range(n)))])
     else:
         world_info = parse_inclusion_exclusion(pool, args.include,
                                                args.exclude)
@@ -239,7 +244,6 @@ def main(args=None) -> int:
                 for h, slots in world_info.items())
     if not world_info:
         raise ValueError("no hosts left after filtering")
-
     if args.elastic_training:
         # The batch plan itself comes from the config's 'elasticity' block
         # at engine init; the launcher enforces the node bounds.
@@ -253,22 +257,54 @@ def main(args=None) -> int:
         os.environ["DS_ELASTIC_NODE_RANGE"] = f"{lo},{hi}"
         logger.info(f"elastic training over {n_nodes} nodes "
                     f"(allowed range [{lo}, {hi}])")
+    return world_info
 
-    master_addr = args.master_addr or next(iter(world_info))
-    multi = (len(world_info) > 1 or args.force_multi) and \
-        args.launcher != "local"
-    if not multi:
-        cmd = build_launch_cmd(args, world_info, 0, master_addr or
-                               "localhost")
-        logger.info(f"launching: {' '.join(cmd)}")
-        return subprocess.call(cmd)
 
-    cmds = build_multinode_cmds(args, world_info, master_addr)
-    procs = [subprocess.Popen(c) for c in cmds]
-    rc = 0
-    for p in procs:
-        rc = rc or p.wait()
-    return rc
+def main(args=None) -> int:
+    args = parse_args(args)
+
+    def launch_once() -> int:
+        world_info = _resolve_world(args)
+        master_addr = args.master_addr or next(iter(world_info))
+        multi = (len(world_info) > 1 or args.force_multi) and \
+            args.launcher != "local"
+        if not multi:
+            cmd = build_launch_cmd(args, world_info, 0, master_addr or
+                                   "localhost")
+            logger.info(f"launching: {' '.join(cmd)}")
+            return subprocess.call(cmd)
+        cmds = build_multinode_cmds(args, world_info, master_addr)
+        procs = [subprocess.Popen(c) for c in cmds]
+        # wait EVERY node launcher (keep the first failure's code): the
+        # next elastic wave must not start while old workers are alive
+        rc = 0
+        for p in procs:
+            r = p.wait()
+            if r != 0 and rc == 0:
+                rc = r
+        return rc
+
+    if not args.elastic_training:
+        return launch_once()
+
+    # Elastic restart loop (reference elasticity/elastic_agent.py:28
+    # DSElasticAgent._invoke_run): a failed worker group is relaunched up
+    # to --max_restarts times; workers resume from their checkpoints
+    # (elastic batch algebra keeps convergence intact across restarts).
+    attempt = 0
+    while True:
+        rc = launch_once()
+        if rc == 0:
+            return 0
+        attempt += 1
+        if attempt > max(args.max_restarts, 0):
+            logger.error(
+                f"elastic training: worker group failed rc={rc} after "
+                f"{attempt - 1} restart(s); giving up")
+            return rc
+        logger.warning(
+            f"elastic training: worker group failed rc={rc}; restart "
+            f"{attempt}/{args.max_restarts}")
 
 
 if __name__ == "__main__":

@@ -209,3 +209,31 @@ def test_engine_config_elastic_batch():
     assert cfg.train_micro_batch_size_per_gpu in (2, 4)
     assert cfg.train_batch_size == (cfg.train_micro_batch_size_per_gpu *
                                     cfg.gradient_accumulation_steps * 8)
+
+
+def test_elastic_restart_loop(tmp_path):
+    """A failed worker group is relaunched up to --max_restarts times
+    (reference DSElasticAgent restart loop): the child fails twice, then
+    succeeds."""
+    marker = tmp_path / "attempts"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import pathlib, sys\n"
+        f"p = pathlib.Path(r'{marker}')\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "sys.exit(0 if n >= 2 else 1)\n")
+    rc = ds_runner.main([
+        "--hostfile", "/nonexistent", "--num_gpus", "1",
+        "--elastic_training", "--max_restarts", "3", str(script)])
+    assert rc == 0
+    assert marker.read_text() == "3"  # two failures + one success
+
+
+def test_elastic_restart_gives_up(tmp_path):
+    script = tmp_path / "alwaysfail.py"
+    script.write_text("import sys; sys.exit(5)\n")
+    rc = ds_runner.main([
+        "--hostfile", "/nonexistent", "--num_gpus", "1",
+        "--elastic_training", "--max_restarts", "1", str(script)])
+    assert rc == 5
