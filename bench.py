@@ -87,10 +87,14 @@ def main():
     import deepspeed_tpu
     from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
 
-    # ~125M-parameter Llama
+    # ~125M-parameter Llama. TPU-first geometry: head_dim=128 (6 heads)
+    # instead of GPT-2's 12x64 — the MXU systolic array and vector lanes
+    # are 128 wide, so 64-dim heads run every attention matmul at half
+    # efficiency and double the softmax element count for identical
+    # parameter count, model FLOPs and hidden size.
     cfg_m = LlamaConfig(vocab_size=32000, hidden_size=768,
                         intermediate_size=2048, num_hidden_layers=12,
-                        num_attention_heads=12, num_key_value_heads=12,
+                        num_attention_heads=6, num_key_value_heads=6,
                         max_position_embeddings=2048, dtype=jnp.bfloat16)
     seq = 1024
     micro_batch = 8

@@ -80,8 +80,43 @@ def flash_attention_usable(q, k, v, causal, mask) -> bool:
 # ===================================================================== #
 # Forward
 # ===================================================================== #
+def _fwd_kernel_onepass(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal,
+                        block_q, block_k, causal_offset, window):
+    """Single-k-block forward (nk == 1): the whole key range is visible in
+    one tile, so the online-softmax running max/sum machinery (scratch
+    init, correction factors, broadcasts) collapses to one plain softmax —
+    several fewer VPU passes over the [bq, bk] tile. q arrives pre-scaled
+    (see flash_attention)."""
+    iq = pl.program_id(2)
+    q = q_ref[0, 0]                                   # [bq, d] bf16
+    kb = k_ref[0, 0]                                  # [bk, d] bf16
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [bq, bk] f32
+    if causal:
+        rows = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        keep = rows + causal_offset >= cols
+        if window is not None:
+            keep = jnp.logical_and(keep, cols > rows + causal_offset - window)
+        s = jnp.where(keep, s, NEG_INF)
+    m = jnp.max(s, axis=1, keepdims=True)             # [bq, 1]
+    p = jnp.exp(s - m)                                # [bq, bk] f32
+    l = jnp.sum(p, axis=1, keepdims=True)             # [bq, 1]
+    vb = v_ref[0, 0]                                  # [bk, d] bf16
+    acc = jax.lax.dot_general(
+        p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / safe_l).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(safe_l),
+                                     lse_ref[0, 0].shape)      # [bq, 8]
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
+                acc_ref, m_ref, l_ref, *, causal, block_q, block_k,
                 num_k_blocks, causal_offset, window):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
@@ -103,11 +138,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
-        kb = k_ref[0, 0].astype(jnp.float32)          # [bk, d]
+        # dots take the INPUT dtype (bf16) and accumulate fp32 via
+        # preferred_element_type — an fp32×fp32 MXU dot runs at ~1/8 the
+        # bf16 rate on TPU and was the single largest cost in the whole
+        # training step before this. q arrives pre-scaled, so no per-tile
+        # [bq, bk] scale pass.
+        q = q_ref[0, 0]                               # [bq, d]
+        kb = k_ref[0, 0]                              # [bk, d]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+            preferred_element_type=jnp.float32)       # [bq, bk] f32
         if causal:
             rows = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -125,9 +165,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new)                         # [bq, bk]
         corr = jnp.exp(m_prev - m_new)                 # [bq, 1]
         l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
-        vb = v_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        vb = v_ref[0, 0]                               # [bk, d] bf16
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -137,52 +177,62 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_ref[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        # lse stored [bq, 128]-wide: TPU block last-dims must be (8k, 128)
-        # (same layout as jax's reference TPU flash kernel's l/m outputs)
-        lse_ref[0, 0] = m_ref[:] + jnp.log(jnp.where(l_ref[:] == 0.0, 1.0,
-                                                     l_ref[:]))
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_ref[:, :1] + jnp.log(safe_l), lse_ref[0, 0].shape)  # [bq, 8]
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
-         window=None):
-    """q:[B,H,Sq,D] k/v:[B,Hkv,Sk,D] -> (o:[B,H,Sq,D], lse:[B,H,Sq])."""
+def _fwd(q, k, v, *, causal, block_q, block_k, interpret, window=None):
+    """q (PRE-SCALED):[B,H,Sq,D] k/v:[B,Hkv,Sk,D]
+    -> (o:[B,H,Sq,D], lse:[B,H,Sq,8])."""
     b, h, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     g = h // hkv
     nq = sq // block_q
     nk = sk // block_k
-    grid = (b, h, nq, nk)
 
-    kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=nk, causal_offset=sk - sq,
-        window=window)
+    if nk == 1:
+        kernel = functools.partial(
+            _fwd_kernel_onepass, causal=causal, block_q=block_q,
+            block_k=block_k, causal_offset=sk - sq, window=window)
+        grid = (b, h, nq)
+        idx_q = lambda b_, h_, iq: (b_, h_, iq, 0)
+        idx_k = lambda b_, h_, iq: (b_, h_ // g, 0, 0)
+        idx_l = lambda b_, h_, iq: (b_, h_, iq, 0)
+        scratch = []
+    else:
+        kernel = functools.partial(
+            _fwd_kernel, causal=causal, block_q=block_q,
+            block_k=block_k, num_k_blocks=nk, causal_offset=sk - sq,
+            window=window)
+        grid = (b, h, nq, nk)
+        idx_q = lambda b_, h_, iq, ik: (b_, h_, iq, 0)
+        idx_k = lambda b_, h_, iq, ik: (b_, h_ // g, ik, 0)
+        idx_l = lambda b_, h_, iq, ik: (b_, h_, iq, 0)
+        scratch = [
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ]
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b_, h_, iq, ik: (b_, h_ // g, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b_, h_, iq, ik: (b_, h_ // g, ik, 0)),
+            pl.BlockSpec((1, 1, block_q, d), idx_q),
+            pl.BlockSpec((1, 1, block_k, d), idx_k),
+            pl.BlockSpec((1, 1, block_k, d), idx_k),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 128),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, d), idx_q),
+            # lse is logically 1-D per (b, h); stored 8 wide (the narrowest
+            # minor dim the TPU lowering accepts) — the 128-wide copy here
+            # cost ~100 MB of fp32 HBM traffic per layer on the 125M bench
+            pl.BlockSpec((1, 1, block_q, 8), idx_l),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, 8), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v)
 
@@ -193,6 +243,8 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_acc, *, scale, causal, block_q, block_k, num_k_blocks,
                    causal_offset, window):
+    # q arrives pre-scaled: s needs no scale; dq needs one final *scale on
+    # the small [bq, d] accumulator (dL/dq = scale * dL/dq_scaled)
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -209,14 +261,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32)
-        kb = k_ref[0, 0].astype(jnp.float32)
-        vb = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 MXU dots with fp32 accumulation (see _fwd_kernel note)
+        q = q_ref[0, 0]
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, :1]                    # [bq, 1]
         delta = delta_ref[0, 0][:, :1]                # [bq, 1]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
         if causal:
             rows = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -227,22 +280,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 keep = jnp.logical_and(
                     keep, cols > rows + causal_offset - window)
             s = jnp.where(keep, s, NEG_INF)
-        p = jnp.exp(s - lse)                          # [bq, bk]
+        p = jnp.exp(s - lse)                          # [bq, bk] f32
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(kb.dtype)
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds, kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32)
 
     @pl.when(ik == num_k_blocks - 1)
     def _():
-        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+        dq_ref[0, 0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, causal,
                     block_q, block_k, num_q_blocks, causal_offset, window):
+    # q arrives pre-scaled: dL/dk = ds^T @ (scale*q) needs no extra scale
     ik = pl.program_id(2)
     iq = pl.program_id(3)
 
@@ -260,14 +314,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32)
-        kb = k_ref[0, 0].astype(jnp.float32)
-        vb = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 MXU dots with fp32 accumulation (see _fwd_kernel note)
+        q = q_ref[0, 0]
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, :1]
         delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
         if causal:
             rows = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -278,16 +333,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 keep = jnp.logical_and(
                     keep, cols > rows + causal_offset - window)
             s = jnp.where(keep, s, NEG_INF)
-        p = jnp.exp(s - lse)                           # [bq, bk]
+        p = jnp.exp(s - lse)                           # [bq, bk] f32
+        pb = p.astype(do.dtype)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pb, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bk, d]
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)                          # [bq, bk]
+        ds = (p * (dp - delta)).astype(q.dtype)        # [bq, bk]
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32)
 
     @pl.when(iq == num_q_blocks - 1)
     def _():
@@ -297,7 +353,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd(res, grads, *, scale, causal, block_q, block_k, interpret,
          window=None):
-    q, k, v, o, lse = res
+    q, k, v, o, lse = res  # q is the PRE-SCALED query
     do = grads[0]
     b, h, sq, d = q.shape
     _, hkv, sk, _ = k.shape
@@ -305,10 +361,10 @@ def _bwd(res, grads, *, scale, causal, block_q, block_k, interpret,
     nq = sq // block_q
     nk = sk // block_k
 
-    # delta_i = rowsum(dO_i * O_i) — cheap, let XLA fuse it; widened to
-    # [B,H,Sq,128] to satisfy TPU block-shape tiling (as lse is)
+    # delta_i = rowsum(dO_i * O_i) — cheap, let XLA fuse it; 8 wide (see
+    # the lse layout note in _fwd)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (8,))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -324,9 +380,9 @@ def _bwd(res, grads, *, scale, causal, block_q, block_k, interpret,
                          lambda b_, h_, iq, ik: (b_, h_ // g, ik, 0)),
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 128),
+            pl.BlockSpec((1, 1, block_q, 8),
                          lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 128),
+            pl.BlockSpec((1, 1, block_q, 8),
                          lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
@@ -338,7 +394,7 @@ def _bwd(res, grads, *, scale, causal, block_q, block_k, interpret,
 
     # dK/dV per q-head, then sum each GQA group
     dk_h, dv_h = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+        functools.partial(_bwd_dkv_kernel, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
                           causal_offset=sk - sq, window=window),
         grid=(b, h, nk, nq),
@@ -351,9 +407,9 @@ def _bwd(res, grads, *, scale, causal, block_q, block_k, interpret,
                          lambda b_, h_, ik, iq: (b_, h_ // g, ik, 0)),
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 128),
+            pl.BlockSpec((1, 1, block_q, 8),
                          lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 128),
+            pl.BlockSpec((1, 1, block_q, 8),
                          lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
         ],
         out_specs=[
@@ -384,15 +440,19 @@ def _bwd(res, grads, *, scale, causal, block_q, block_k, interpret,
 # ===================================================================== #
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret, window):
-    o, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+    # fold the softmax scale into q once ([B,H,S,D] — 16x smaller than one
+    # [bq, bk] pass per tile inside the kernel)
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    o, _ = _fwd(qs, k, v, causal=causal, block_q=block_q,
                 block_k=block_k, interpret=interpret, window=window)
     return o
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window):
-    o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    o, lse = _fwd(qs, k, v, causal=causal, block_q=block_q,
                   block_k=block_k, interpret=interpret, window=window)
-    return o, (q, k, v, o, lse)
+    return o, (qs, k, v, o, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, window, res, g):
