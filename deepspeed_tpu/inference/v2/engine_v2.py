@@ -25,7 +25,9 @@ import numpy as np
 
 from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
 from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
+    KV_SPEC,
     RaggedLlama,
+    shard_ragged_params,
 )
 from deepspeed_tpu.inference.v2.ragged import (DSStateManager,
                                                RaggedBatchWrapper)
@@ -52,6 +54,18 @@ class InferenceEngineV2:
             max_seqs=sm_cfg.max_ragged_sequence_count,
             max_blocks=self._max_blocks,
             block_size=kv_cfg.block_size)
+        # Tensor parallelism (reference inference/v2/model_implementations/
+        # sharding/): the model is mesh-bound -> place params by the
+        # Megatron split rules and the KV pool kv-head-split, so the
+        # shard_map'd step reads them without any resharding
+        if getattr(model, "tp", 1) > 1:
+            from jax.sharding import NamedSharding
+
+            self.params = shard_ragged_params(params, model.mesh)
+            kv_sh = NamedSharding(model.mesh, KV_SPEC)
+            self.state_manager.kv_cache.cache = jax.tree.map(
+                lambda x: jax.device_put(x, kv_sh),
+                self.state_manager.kv_cache.cache)
         # donate the KV pool: the old cache is dead the moment
         # state_manager.kv_cache.update() stores the new one, and donation
         # lets XLA update the pool in place instead of copying it per step

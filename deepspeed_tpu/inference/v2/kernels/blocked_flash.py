@@ -34,7 +34,7 @@ NEG_INF = -1e30
 
 def _kernel(token_slot, token_pos, tables, q_ref, k_ref, v_ref, o_ref,
             acc_ref, m_ref, l_ref, *, block_size, num_blocks_per_seq,
-            scale):
+            scale, window):
     t = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -45,8 +45,12 @@ def _kernel(token_slot, token_pos, tables, q_ref, k_ref, v_ref, o_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     pos = token_pos[t]
-    # skip blocks entirely past this token's position
+    # skip blocks entirely past this token's position; with a sliding
+    # window (Mistral SWA) also skip blocks entirely below pos - window
     run = j * block_size <= pos
+    if window is not None:
+        run = jnp.logical_and(run,
+                              (j + 1) * block_size - 1 > pos - window)
 
     @pl.when(run)
     def _():
@@ -63,7 +67,10 @@ def _kernel(token_slot, token_pos, tables, q_ref, k_ref, v_ref, o_ref,
             preferred_element_type=jnp.float32) * scale
         key_pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (hkv, g, block_size), 2)
-        s = jnp.where(key_pos <= pos, s, NEG_INF)
+        keep = key_pos <= pos
+        if window is not None:
+            keep = jnp.logical_and(keep, key_pos > pos - window)
+        s = jnp.where(keep, s, NEG_INF)
 
         sh = s.reshape(h, block_size)
         m_prev = m_ref[:, :1]
@@ -95,16 +102,20 @@ def paged_attention_usable(q, k_pool, block_size: int) -> bool:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block_size", "interpret"))
+                   static_argnames=("block_size", "window", "interpret"))
 def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                     v_pool: jnp.ndarray, block_tables: jnp.ndarray,
                     token_slot: jnp.ndarray, token_pos: jnp.ndarray,
-                    *, block_size: int,
+                    *, block_size: int, window: Any = None,
                     interpret: Any = None) -> jnp.ndarray:
     """q: [T, H, D]; k/v_pool: [num_blocks*block_size, Hkv, D];
     block_tables: [S, B] int32; token_slot/token_pos: [T] int32.
     Returns [T, H, D] — each token attends over its sequence's paged
-    context up to its own position.
+    context up to its own position; ``window`` (Mistral SWA) restricts it
+    to the last ``window`` positions, with out-of-band pool blocks skipped
+    entirely (the DMA index map clamps into the live band, so skipped
+    iterations re-name an already-resident block and the pipeline elides
+    the transfer).
     """
     t_count, h, d = q.shape
     hkv = k_pool.shape[1]
@@ -120,25 +131,24 @@ def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     vp = v_pool.reshape(nb, block_size, hkv, d)
     scale = 1.0 / (d ** 0.5)
 
+    def _kv_index(t, j, slot, pos, tab):
+        # clamp out-of-band block indices into the token's live band:
+        # skipped iterations then revisit an already-resident pool block,
+        # which the Pallas pipeline elides instead of DMAing garbage
+        jj = jnp.minimum(j, pos[t] // block_size)
+        if window is not None:
+            lo = jnp.maximum((pos[t] - window + 1) // block_size, 0)
+            jj = jnp.maximum(jj, jnp.minimum(lo, pos[t] // block_size))
+        return (tab[slot[t], jj], 0, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(t_count, b_per_seq),
         in_specs=[
             pl.BlockSpec((1, h, d),
                          lambda t, j, slot, pos, tab: (t, 0, 0)),
-            # clamp past-position block indices to the token's last valid
-            # block: skipped iterations then revisit the same pool block,
-            # which the Pallas pipeline elides instead of DMAing garbage
-            pl.BlockSpec((1, block_size, hkv, d),
-                         lambda t, j, slot, pos, tab:
-                         (tab[slot[t],
-                              jnp.minimum(j, pos[t] // block_size)],
-                          0, 0, 0)),
-            pl.BlockSpec((1, block_size, hkv, d),
-                         lambda t, j, slot, pos, tab:
-                         (tab[slot[t],
-                              jnp.minimum(j, pos[t] // block_size)],
-                          0, 0, 0)),
+            pl.BlockSpec((1, block_size, hkv, d), _kv_index),
+            pl.BlockSpec((1, block_size, hkv, d), _kv_index),
         ],
         out_specs=pl.BlockSpec((1, h, d),
                                lambda t, j, slot, pos, tab: (t, 0, 0)),
@@ -149,7 +159,8 @@ def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         ],
     )
     kernel = functools.partial(_kernel, block_size=block_size,
-                               num_blocks_per_seq=b_per_seq, scale=scale)
+                               num_blocks_per_seq=b_per_seq, scale=scale,
+                               window=window)
     return pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t_count, h, d), q.dtype),

@@ -1,8 +1,20 @@
 """Inference v2 model implementations (reference:
-inference/v2/model_implementations/)."""
+inference/v2/model_implementations/ — llama_v2, opt, mistral, mixtral,
+falcon families)."""
 
 from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
     RaggedLlama,
+    ragged_param_specs,
+    shard_ragged_params,
+)
+from deepspeed_tpu.inference.v2.model_implementations.ragged_mixtral import (
+    RaggedMixtral,
 )
 
-__all__ = ["RaggedLlama"]
+# Mistral is the Llama architecture + sliding window: serve it with
+# RaggedLlama over a config whose ``sliding_window`` is set (reference
+# mistral/ container reuses the llama modules the same way)
+RaggedMistral = RaggedLlama
+
+__all__ = ["RaggedLlama", "RaggedMistral", "RaggedMixtral",
+           "ragged_param_specs", "shard_ragged_params"]

@@ -21,6 +21,16 @@ max sequences S, block-table width B) and the flat paged KV pool from
   This is the XLA reference path; a Pallas paged-attention kernel can consume
   the identical layout.
 
+Tensor parallelism (reference ``inference/v2/model_implementations/sharding/
+{qkv,attn,attn_out,mlp,embedding,unembed}.py``): a ``shard_map`` over the
+'model' mesh axis with Megatron-style splits —
+
+* embedding vocab-split (masked local lookup + psum),
+* QKV / gate / up column-split (each shard owns ``H/tp`` heads and the
+  matching slice of the KV pool; the paged kernel runs on the LOCAL shard),
+* attn-out / down row-split followed by the ONLY two per-layer all-reduces,
+* unembed (lm_head) vocab-split with an all-gather of the per-slot logits.
+
 The param tree is EXACTLY :class:`models.llama.LlamaForCausalLM`'s, so v1 and
 v2 engines share checkpoints and the continuous-batching correctness test can
 compare the two token-for-token.
@@ -28,12 +38,43 @@ compare the two token-for-token.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import functools
+import re
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.models.llama import LlamaConfig, apply_rotary
+
+# Megatron split rules over the 'model' axis (reference
+# inference/v2/model_implementations/sharding/*.py) — serving shares the
+# training rules so a sharding change propagates to both
+from deepspeed_tpu.models.llama import LLAMA_PARTITION_RULES as _TP_RULES
+
+
+def ragged_param_specs(params) -> Any:
+    """PartitionSpec tree for the ragged Llama param tree."""
+    def spec_for(path, _leaf):
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        for pat, spec in _TP_RULES:
+            if re.search(pat, name):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_ragged_params(params, mesh: Mesh) -> Any:
+    """Place a (host or replicated) param tree sharded for TP serving."""
+    specs = ragged_param_specs(params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+KV_SPEC = P(None, "model", None)  # pool [flat, Hkv, D]: kv heads split
 
 
 def _rms_norm(x, scale, eps):
@@ -44,11 +85,12 @@ def _rms_norm(x, scale, eps):
 
 
 def _paged_attention(q, k_pool, v_pool, batch, block_size,
-                     use_kernel=None):
+                     use_kernel=None, window=None):
     """Paged attention over the blocked KV pool.
 
     q: [T, H, D]; k_pool/v_pool: [num_blocks*bs, Hkv, D].
-    Returns [T, H, D].
+    Returns [T, H, D]. Under TP the caller passes LOCAL heads — the kernel
+    is oblivious to the mesh. ``window`` = Mistral sliding-window width.
 
     On TPU this routes to the Pallas blocked-flash kernel
     (inference/v2/kernels/blocked_flash.py): block tables drive the
@@ -69,7 +111,8 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
             return paged_attention(
                 q, k_pool, v_pool, batch["block_tables"],
                 batch["token_slot"], batch["token_pos"],
-                block_size=block_size)
+                block_size=block_size,
+                window=int(window) if window is not None else None)
     block_tables = batch["block_tables"]          # [S, B]
     token_slot = batch["token_slot"]              # [T]
     token_pos = batch["token_pos"]                # [T]
@@ -97,20 +140,73 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
     qg = qf.reshape(q.shape[0], hkv, group, q.shape[2])
     scores = jnp.einsum("tkgd,tckd->tkgc", qg, kf) / jnp.sqrt(
         jnp.float32(q.shape[-1]))
-    mask = (jnp.arange(C, dtype=jnp.int32)[None, :]
-            <= token_pos[:, None])                # [T, C]
+    key_pos = jnp.arange(C, dtype=jnp.int32)[None, :]
+    mask = key_pos <= token_pos[:, None]          # [T, C]
+    if window is not None:
+        mask &= key_pos > token_pos[:, None] - window
     scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("tkgc,tckd->tkgd", probs, v_t.astype(jnp.float32))
     return out.reshape(q.shape).astype(q.dtype)
 
 
-class RaggedLlama:
-    """Callable ragged forward bound to a :class:`LlamaConfig`."""
+def ragged_attention_block(lp_attn, xa, layer_cache, batch, block_size, cfg,
+                           h, hkv, d, cos, sin, ax=None):
+    """Shared per-layer attention body (RaggedLlama + RaggedMixtral):
+    qkv proj → rotary → paged-KV scatter → blocked-flash → o_proj
+    (+ row-parallel psum under TP). ``h``/``hkv`` are LOCAL head counts.
+    Returns ``(attn_out [T, H_model], new_layer_cache)``."""
+    dt = cfg.dtype
+    kv_dest = batch["kv_dest"]
+    q = (xa @ lp_attn["q_proj"]["kernel"].astype(dt)).reshape(-1, h, d)
+    k = (xa @ lp_attn["k_proj"]["kernel"].astype(dt)).reshape(-1, hkv, d)
+    v = (xa @ lp_attn["v_proj"]["kernel"].astype(dt)).reshape(-1, hkv, d)
+    # apply_rotary broadcasts over [T, H, D] with cos/sin [T, 1, D/2]
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    k_pool = layer_cache["k"].at[kv_dest].set(k.astype(layer_cache["k"].dtype))
+    v_pool = layer_cache["v"].at[kv_dest].set(v.astype(layer_cache["v"].dtype))
+    out = _paged_attention(q, k_pool, v_pool, batch, block_size,
+                           window=cfg.sliding_window)
+    out = out.reshape(-1, h * d) @ lp_attn["o_proj"]["kernel"].astype(dt)
+    if ax is not None:
+        out = jax.lax.psum(out, ax)                   # row-parallel attn-out
+    return out, {"k": k_pool, "v": v_pool}
 
-    def __init__(self, config: LlamaConfig, block_size: int):
+
+class RaggedLlama:
+    """Callable ragged forward bound to a :class:`LlamaConfig`.
+
+    ``mesh`` with a non-trivial 'model' axis turns on tensor parallelism:
+    ``__call__`` becomes a shard_map over that axis (params/KV pool must be
+    placed with :func:`shard_ragged_params` / ``KV_SPEC`` — the engine does
+    this).
+    """
+
+    def __init__(self, config: LlamaConfig, block_size: int,
+                 mesh: Optional[Mesh] = None, tp_axis: str = "model"):
         self.config = config
         self.block_size = block_size
+        self.tp_axis = tp_axis
+        self.mesh = None
+        self.tp = 1
+        if mesh is not None and mesh.shape.get(tp_axis, 1) > 1:
+            self.bind_mesh(mesh, tp_axis)
+
+    def bind_mesh(self, mesh: Mesh, tp_axis: str = "model") -> None:
+        tp = mesh.shape[tp_axis]
+        cfg = self.config
+        for name, n in (("num_attention_heads", cfg.num_attention_heads),
+                        ("num_key_value_heads", cfg.num_key_value_heads),
+                        ("vocab_size", cfg.vocab_size),
+                        ("intermediate_size", cfg.intermediate_size)):
+            if n % tp != 0:
+                raise ValueError(
+                    f"FastGen TP: {name}={n} not divisible by "
+                    f"model-parallel degree {tp}")
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        self.tp = tp
 
     @property
     def num_layers(self):
@@ -131,49 +227,78 @@ class RaggedLlama:
         Returns ``(logits [S, vocab], new_kv_cache)`` where row ``s`` holds
         the logits of slot ``s``'s LAST scheduled token.
         """
+        if self.tp == 1:
+            return self._forward(params, kv_cache, batch, ax=None)
+        from jax.experimental.shard_map import shard_map
+
+        param_specs = ragged_param_specs(params)
+        cache_specs = jax.tree.map(lambda _x: KV_SPEC, kv_cache)
+        batch_specs = jax.tree.map(lambda _x: P(), batch)
+        fwd = functools.partial(self._forward, ax=self.tp_axis)
+        return shard_map(
+            fwd, mesh=self.mesh,
+            in_specs=(param_specs, cache_specs, batch_specs),
+            out_specs=(P(), cache_specs),
+            check_rep=False,
+        )(params, kv_cache, batch)
+
+    # ------------------------------------------------------------------ #
+    def _embed(self, emb, token_ids, ax):
+        """Vocab-parallel embedding (reference sharding/embedding.py):
+        masked local-range lookup + psum."""
+        if ax is None:
+            return emb[token_ids]
+        v_local = emb.shape[0]
+        start = jax.lax.axis_index(ax) * v_local
+        loc = token_ids - start
+        ok = (loc >= 0) & (loc < v_local)
+        x = jnp.where(ok[:, None], emb[jnp.clip(loc, 0, v_local - 1)], 0)
+        return jax.lax.psum(x, ax)
+
+    def _forward(self, params, kv_cache, batch, *, ax):
         cfg = self.config
         m = params["model"]
         dt = cfg.dtype
+        tp = self.tp if ax is not None else 1
         token_ids = batch["token_ids"]            # [T]
         token_pos = batch["token_pos"]            # [T]
-        kv_dest = batch["kv_dest"]                # [T]
 
-        x = m["embed_tokens"]["embedding"].astype(dt)[token_ids]   # [T, H]
-        h, hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
-                     cfg.head_dim)
+        x = self._embed(m["embed_tokens"]["embedding"].astype(dt), token_ids,
+                        ax)                                        # [T, H]
+        h, hkv, d = (cfg.num_attention_heads // tp,
+                     cfg.num_key_value_heads // tp, cfg.head_dim)
         cos, sin = _rotary(token_pos, d, cfg.rope_theta)
         new_cache = {}
         for i in range(cfg.num_hidden_layers):
             lp = m[f"layers_{i}"]
-            attn, mlp = lp["self_attn"], lp["mlp"]
+            mlp = lp["mlp"]
             xa = _rms_norm(x, lp["input_layernorm"]["scale"],
                            cfg.rms_norm_eps)
-            q = (xa @ attn["q_proj"]["kernel"].astype(dt)).reshape(-1, h, d)
-            k = (xa @ attn["k_proj"]["kernel"].astype(dt)).reshape(-1, hkv, d)
-            v = (xa @ attn["v_proj"]["kernel"].astype(dt)).reshape(-1, hkv, d)
-            # apply_rotary broadcasts over [T, H, D] with cos/sin [T, 1, D/2]
-            q = apply_rotary(q, cos, sin)
-            k = apply_rotary(k, cos, sin)
-            layer = kv_cache[f"layer_{i}"]
-            k_pool = layer["k"].at[kv_dest].set(k.astype(layer["k"].dtype))
-            v_pool = layer["v"].at[kv_dest].set(v.astype(layer["v"].dtype))
-            new_cache[f"layer_{i}"] = {"k": k_pool, "v": v_pool}
-            out = _paged_attention(q, k_pool, v_pool, batch, self.block_size)
-            out = out.reshape(-1, h * d) @ attn["o_proj"]["kernel"].astype(dt)
+            out, new_cache[f"layer_{i}"] = ragged_attention_block(
+                lp["self_attn"], xa, kv_cache[f"layer_{i}"], batch,
+                self.block_size, cfg, h, hkv, d, cos, sin, ax=ax)
             x = x + out
             xm = _rms_norm(x, lp["post_attention_layernorm"]["scale"],
                            cfg.rms_norm_eps)
             gate = xm @ mlp["gate_proj"]["kernel"].astype(dt)
             up = xm @ mlp["up_proj"]["kernel"].astype(dt)
-            x = x + (jax.nn.silu(gate) * up) @ \
-                mlp["down_proj"]["kernel"].astype(dt)
+            mo = (jax.nn.silu(gate) * up) @ mlp["down_proj"]["kernel"].astype(dt)
+            if ax is not None:
+                mo = jax.lax.psum(mo, ax)         # row-parallel mlp-down
+            x = x + mo
         x = _rms_norm(x, m["norm"]["scale"], cfg.rms_norm_eps)
         if cfg.tie_word_embeddings:
             logits = x @ m["embed_tokens"]["embedding"].astype(dt).T
+            # tied unembed against the vocab-split table: gather below
         else:
             logits = x @ params["lm_head"]["kernel"].astype(dt)
-        # ★logits_gather analog: only each slot's last token (SURVEY §3.5)
-        return logits[batch["logits_idx"]], new_cache
+        # ★logits_gather analog: slice each slot's last token FIRST, then
+        # (TP) all-gather only the [S, V/tp] slice (reference
+        # sharding/unembed.py gathers the sliced logits too)
+        logits = logits[batch["logits_idx"]]
+        if ax is not None:
+            logits = jax.lax.all_gather(logits, ax, axis=1, tiled=True)
+        return logits, new_cache
 
 
 def _rotary(positions, head_dim, theta):

@@ -1,0 +1,116 @@
+"""Ragged (paged-KV) Mixtral forward for the FastGen engine.
+
+Reference analog: ``inference/v2/model_implementations/mixtral/`` served by
+the MoE ragged kernels (``kernels/ragged_ops/{top_k_gating,moe_scatter,
+moe_gather}/``, ``kernels/cutlass_ops/moe_gemm/``).
+
+TPU-native design: the attention/paged-KV machinery is shared with
+:class:`RaggedLlama` (same flat token buffer, same blocked-flash kernel);
+the FFN is a **dropless** top-k routed MoE over the flat ``[T, H]`` buffer:
+
+* router logits + top-k + renormalised weights per token (the reference's
+  ★top_k_gating kernel; HF Mixtral inference semantics),
+* dense einsum dispatch: every expert processes the full token buffer and
+  the combine mask zeroes unselected rows (the reference's moe_scatter/
+  moe_gemm/moe_gather pipeline; a sorted grouped-matmul Pallas kernel can
+  replace the einsum without changing this layout).
+
+Dropless gating is what makes MoE *ragged-safe*: with no capacity buckets
+there is no cross-token interaction, so the pad lanes of the token budget
+cannot perturb real tokens' routing — the property capacity-based gating
+(runtime/moe/sharded_moe.py top2gating) does not have.
+
+The param tree is EXACTLY :class:`models.mixtral.MixtralForCausalLM`'s, so
+training checkpoints serve directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
+    _rms_norm,
+    _rotary,
+    ragged_attention_block,
+)
+from deepspeed_tpu.models.mixtral import MixtralConfig
+
+
+def dropless_moe(x, moe_params, k: int, dtype):
+    """Dropless top-k MoE over a flat token buffer.
+
+    x: [T, H]; returns [T, H]. Router math in fp32 (reference TopKGate is
+    fp32, sharded_moe.py:348); expert compute in ``dtype``.
+    """
+    wg = moe_params["gate"]["wg"]["kernel"]            # [H, E]
+    experts = moe_params["experts"]
+    logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)   # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)               # [T, k]
+    w = topv / jnp.maximum(jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
+    e_count = wg.shape[1]
+    # combine weights [T, E]: w_t for selected experts, 0 otherwise
+    comb = jnp.sum(jax.nn.one_hot(topi, e_count, dtype=jnp.float32)
+                   * w[..., None], axis=1)             # [T, E]
+    w_gate = experts["w_gate"].astype(dtype)           # [E, H, F]
+    w_up = experts["w_up"].astype(dtype)
+    w_down = experts["w_down"].astype(dtype)
+    xe = x.astype(dtype)
+    h = jax.nn.silu(jnp.einsum("tm,emf->etf", xe, w_gate)) * \
+        jnp.einsum("tm,emf->etf", xe, w_up)            # [E, T, F]
+    out = jnp.einsum("etf,efm->etm", h, w_down)        # [E, T, H]
+    return jnp.einsum("te,etm->tm", comb.astype(dtype), out)
+
+
+class RaggedMixtral:
+    """Callable ragged MoE forward bound to a :class:`MixtralConfig`."""
+
+    def __init__(self, config: MixtralConfig, block_size: int):
+        self.config = config
+        self.block_size = block_size
+        self.tp = 1  # MoE TP serving composes via the 'expert' axis later
+
+    @property
+    def num_layers(self):
+        return self.config.num_hidden_layers
+
+    @property
+    def num_kv_heads(self):
+        return self.config.num_key_value_heads
+
+    @property
+    def head_dim(self):
+        return self.config.head_dim
+
+    def __call__(self, params: Dict[str, Any], kv_cache: Dict[str, Any],
+                 batch: Dict[str, jax.Array]):
+        """Returns ``(logits [S, vocab], new_kv_cache)``."""
+        cfg = self.config
+        dt = cfg.dtype
+        token_ids = batch["token_ids"]
+        token_pos = batch["token_pos"]
+
+        x = params["embed_tokens"]["embedding"].astype(dt)[token_ids]
+        h, hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        cos, sin = _rotary(token_pos, d, cfg.rope_theta)
+        new_cache = {}
+        for i in range(cfg.num_hidden_layers):
+            lp = params[f"layers_{i}"]
+            xa = _rms_norm(x, lp["input_layernorm"]["scale"],
+                           cfg.rms_norm_eps)
+            out, new_cache[f"layer_{i}"] = ragged_attention_block(
+                lp["self_attn"], xa, kv_cache[f"layer_{i}"], batch,
+                self.block_size, cfg, h, hkv, d, cos, sin)
+            x = x + out
+            xm = _rms_norm(x, lp["post_attention_layernorm"]["scale"],
+                           cfg.rms_norm_eps)
+            x = x + dropless_moe(
+                xm, lp["block_sparse_moe"]["deepspeed_moe"],
+                cfg.num_experts_per_tok, dt)
+        x = _rms_norm(x, params["norm"]["scale"], cfg.rms_norm_eps)
+        logits = x @ params["lm_head"]["kernel"].astype(dt)
+        return logits[batch["logits_idx"]], new_cache

@@ -248,8 +248,10 @@ def test_ragged_engine_with_kernel_path():
 
     orig = rl._paged_attention
 
-    def forced(q, k_pool, v_pool, batch, block_size, use_kernel=None):
-        return orig(q, k_pool, v_pool, batch, block_size, use_kernel=True)
+    def forced(q, k_pool, v_pool, batch, block_size, use_kernel=None,
+               window=None):
+        return orig(q, k_pool, v_pool, batch, block_size, use_kernel=True,
+                    window=window)
 
     params = _params()
     engine_ref = _v2_engine(params)
@@ -266,3 +268,198 @@ def test_ragged_engine_with_kernel_path():
     np.testing.assert_allclose(np.asarray(k_logits[7]),
                                np.asarray(ref_logits[7]),
                                rtol=2e-4, atol=2e-4)
+
+
+# ------------------------------------------------------------------ #
+# Tensor parallelism (reference inference/v2/model_implementations/
+# sharding/{qkv,attn_out,mlp,embedding,unembed}.py)
+# ------------------------------------------------------------------ #
+TP_CFG = LlamaConfig.tiny(num_key_value_heads=4, dtype=jnp.float32)
+
+
+def _tp_params():
+    return LlamaForCausalLM(TP_CFG).init(
+        jax.random.key(0), np.zeros((1, 4), np.int32))["params"]
+
+
+def _tp_engine(params, tp, token_budget=16, block_size=8, max_context=64):
+    topo = groups.initialize_mesh(model_parallel_size=tp)
+    cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": token_budget,
+                          "max_ragged_sequence_count": 4,
+                          "max_context": max_context},
+        "kv_cache": {"block_size": block_size},
+    })
+    model = RaggedLlama(TP_CFG, block_size, mesh=topo.mesh)
+    return InferenceEngineV2(model, params, cfg)
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_v2_tensor_parallel_matches_tp1(tp):
+    """put/query/flush token parity at model=2 and model=4: the shard_map
+    TP forward must generate exactly the tp=1 engine's tokens."""
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, TP_CFG.vocab_size, size=(n,)).tolist()
+               for n in (7, 3)]
+    params = _tp_params()
+    groups.initialize_mesh(model_parallel_size=1)
+    eng1 = InferenceEngineV2(
+        RaggedLlama(TP_CFG, 8), params,
+        RaggedInferenceEngineConfig.from_dict({
+            "state_manager": {"max_ragged_batch_size": 16,
+                              "max_ragged_sequence_count": 4,
+                              "max_context": 64},
+            "kv_cache": {"block_size": 8}}))
+    want = eng1.generate(prompts, max_new_tokens=6)
+
+    eng = _tp_engine(params, tp)
+    got = eng.generate(prompts, max_new_tokens=6)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_v2_tp_rejects_indivisible_heads():
+    topo = groups.initialize_mesh(model_parallel_size=8)
+    with pytest.raises(ValueError, match="divisible"):
+        RaggedLlama(LlamaConfig.tiny(num_key_value_heads=2), 8,
+                    mesh=topo.mesh)  # hkv=2 % 8 != 0
+
+
+def test_v2_tp_hlo_only_rowparallel_allreduce():
+    """The TP step's HLO carries exactly the Megatron collective pattern:
+    one psum for the vocab-split embedding + 2 per layer (attn-out,
+    mlp-down), and one all-gather for the vocab-split unembed — nothing
+    else (no per-projection resharding)."""
+    from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
+        KV_SPEC, shard_ragged_params)
+    from jax.sharding import NamedSharding
+
+    params = _tp_params()
+    topo = groups.initialize_mesh(model_parallel_size=2)
+    model = RaggedLlama(TP_CFG, 8, mesh=topo.mesh)
+    params = shard_ragged_params(params, topo.mesh)
+    kv_sh = NamedSharding(topo.mesh, KV_SPEC)
+    cache = {f"layer_{i}": {
+        "k": jax.device_put(jnp.zeros((32, TP_CFG.num_key_value_heads,
+                                       TP_CFG.head_dim), jnp.float32), kv_sh),
+        "v": jax.device_put(jnp.zeros((32, TP_CFG.num_key_value_heads,
+                                       TP_CFG.head_dim), jnp.float32), kv_sh)}
+        for i in range(TP_CFG.num_hidden_layers)}
+    meta = {
+        "token_ids": jnp.zeros((8,), jnp.int32),
+        "token_slot": jnp.zeros((8,), jnp.int32),
+        "token_pos": jnp.arange(8, dtype=jnp.int32),
+        "kv_dest": jnp.arange(8, dtype=jnp.int32),
+        "block_tables": jnp.zeros((4, 4), jnp.int32),
+        "context_lens": jnp.zeros((4,), jnp.int32),
+        "logits_idx": jnp.zeros((4,), jnp.int32),
+    }
+    txt = jax.jit(model.__call__).lower(params, cache, meta).as_text()
+    n_ar = txt.count("stablehlo.all_reduce")
+    n_ag = txt.count("stablehlo.all_gather\"")
+    want_ar = 1 + 2 * TP_CFG.num_hidden_layers
+    assert n_ar == want_ar, f"expected {want_ar} all-reduces, HLO has {n_ar}"
+    assert n_ag == 1, f"expected 1 all-gather (unembed), HLO has {n_ag}"
+
+
+# ------------------------------------------------------------------ #
+# Mistral sliding-window serving (reference inference/v2/
+# model_implementations/mistral/ + SWA in the blocked-flash kernel)
+# ------------------------------------------------------------------ #
+def test_paged_attention_kernel_window_matches_xla():
+    from deepspeed_tpu.inference.v2.kernels import paged_attention
+    from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
+        _paged_attention)
+
+    rng = np.random.default_rng(9)
+    bs, nb, hkv, d, h, W = 8, 8, 2, 16, 4, 12
+    k_pool = jnp.asarray(rng.normal(size=(nb * bs, hkv, d)).astype(np.float32))
+    v_pool = jnp.asarray(rng.normal(size=(nb * bs, hkv, d)).astype(np.float32))
+    tables = jnp.asarray([[1, 2, 3, 4], [5, 6, 0, 0]], jnp.int32)
+    token_slot = jnp.asarray([0, 0, 1, 0], jnp.int32)
+    token_pos = jnp.asarray([30, 13, 11, 5], jnp.int32)  # 30 crosses window
+    q = jnp.asarray(rng.normal(size=(4, h, d)).astype(np.float32))
+    batch = {"block_tables": tables, "token_slot": token_slot,
+             "token_pos": token_pos}
+    ref = _paged_attention(q, k_pool, v_pool, batch, bs, use_kernel=False,
+                           window=W)
+    got = paged_attention(q, k_pool, v_pool, tables, token_slot, token_pos,
+                          block_size=bs, window=W)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_v2_mistral_swa_matches_v1_past_window():
+    """Ragged Mistral (SWA) == v1 engine token-for-token, with generation
+    running PAST the window boundary (context 10+24 > window 16)."""
+    from deepspeed_tpu.models.mistral import mistral_tiny
+
+    cfg = mistral_tiny(dtype=jnp.float32)        # sliding_window=16
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.key(1), np.zeros((1, 4), np.int32))["params"]
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, cfg.vocab_size, size=(10,)).tolist()
+
+    topo = groups.initialize_mesh(model_parallel_size=1)
+    v1 = deepspeed_tpu.init_inference(model=LlamaForCausalLM(cfg),
+                                      config={"dtype": "fp32"},
+                                      topology=topo)
+    v1.params = jax.device_put(params)
+    want = np.asarray(v1.generate(np.asarray(prompt, np.int32)[None],
+                                  max_new_tokens=24))[0, len(prompt):]
+
+    eng = InferenceEngineV2(
+        RaggedLlama(cfg, 8), params,
+        RaggedInferenceEngineConfig.from_dict({
+            "state_manager": {"max_ragged_batch_size": 16,
+                              "max_ragged_sequence_count": 4,
+                              "max_context": 64},
+            "kv_cache": {"block_size": 8}}))
+    got = eng.generate([prompt], max_new_tokens=24)[0]
+    assert len(prompt) + len(got) > cfg.sliding_window
+    np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------------------------ #
+# Mixtral MoE serving (reference inference/v2/model_implementations/
+# mixtral/ + ragged_ops/{top_k_gating,moe_scatter,moe_gather})
+# ------------------------------------------------------------------ #
+def test_v2_mixtral_matches_cache_free_forward():
+    from deepspeed_tpu.inference.v2.model_implementations import RaggedMixtral
+    from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    # ample capacity -> the training forward's capacity gating == dropless
+    cfg = MixtralConfig.tiny(dtype=jnp.float32, moe_capacity_factor=8.0)
+    model = MixtralForCausalLM(cfg)
+    params = model.init(jax.random.key(2),
+                        np.zeros((1, 4), np.int32))["params"]
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(n,)).tolist()
+               for n in (6, 3)]
+
+    # cache-free greedy reference: full forward per emitted token
+    def ref_tokens(prompt, n_new):
+        ids = list(prompt)
+        out = []
+        for _ in range(n_new):
+            logits = model.apply({"params": params},
+                                 np.asarray(ids, np.int32)[None],
+                                 train=False)
+            nxt = int(np.argmax(np.asarray(logits)[0, -1]))
+            out.append(nxt)
+            ids.append(nxt)
+        return out
+
+    want = [ref_tokens(p, 6) for p in prompts]
+
+    groups.initialize_mesh(model_parallel_size=1)
+    eng = InferenceEngineV2(
+        RaggedMixtral(cfg, 8), params,
+        RaggedInferenceEngineConfig.from_dict({
+            "state_manager": {"max_ragged_batch_size": 8,
+                              "max_ragged_sequence_count": 4,
+                              "max_context": 64},
+            "kv_cache": {"block_size": 8}}))
+    got = eng.generate(prompts, max_new_tokens=6)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, np.asarray(w))
