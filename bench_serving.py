@@ -1,0 +1,145 @@
+"""Serving benchmark: FastGen ragged Llama (125M-class) on one chip.
+
+Methodology follows the reference's FastGen benchmark framing
+(blogs/deepspeed-fastgen/README.md:139-168): N concurrent clients submit
+prompts, we record per-client TTFT (prompt submitted -> first token out,
+prefill through the SplitFuse ragged engine) and the steady-state decode
+throughput with all clients batched continuously.
+
+Prints ONE JSON line shaped like bench.py's. ``vs_baseline`` compares the
+measured steady-state decode tokens/s against HALF the single-chip HBM
+roofline for batched decode (each decode step must stream all model
+weights once per ragged batch: roofline tok/s = clients * BW /
+model_bytes; sustaining >=50% of a memory roofline is the same bar the
+reference's >=54%-of-peak training claim sets for compute).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    # 125M-class Llama, TPU-first head geometry (see bench.py)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                      intermediate_size=2048, num_hidden_layers=12,
+                      num_attention_heads=6, num_key_value_heads=6,
+                      max_position_embeddings=2048, dtype=jnp.bfloat16)
+    clients = 8
+    prompt_len = 256
+    gen_tokens = 64
+    block_size = 128
+
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.key(0), np.zeros((1, 8), np.int32))["params"]
+    params = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+
+    eng_cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": 512,
+                          "max_ragged_sequence_count": clients,
+                          "max_context": prompt_len + gen_tokens + 8},
+        "kv_cache": {"block_size": block_size},
+    })
+    engine = InferenceEngineV2(RaggedLlama(cfg, block_size), params, eng_cfg)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(prompt_len,)).tolist()
+               for _ in range(clients)]
+    uids = list(range(clients))
+
+    # warmup: compile prefill + per-put decode + decode_loop programs,
+    # then reset KV state
+    engine.put([99], [prompts[0]])
+    engine.put([99], [[1]])
+    engine.decode_loop([99], [1], gen_tokens)
+    engine.flush([99])
+
+    # --- TTFT: submit each client's prompt, time to its first token.
+    # put() device_gets the logits, so wall-clock here is real device time.
+    ttft_ms = []
+    next_tok = {}
+    for uid in uids:
+        t0 = time.perf_counter()
+        logits = engine.put([uid], [prompts[uid]])
+        next_tok[uid] = int(np.argmax(logits[uid]))
+        ttft_ms.append((time.perf_counter() - t0) * 1000)
+
+    # --- steady-state decode: device-resident loop (one dispatch per
+    # gen_tokens; on-device argmax + metadata advance). Also record the
+    # per-put() host-loop rate for comparison.
+    t0 = time.perf_counter()
+    toks = engine.decode_loop(uids, [next_tok[u] for u in uids],
+                              gen_tokens)
+    decode_s = time.perf_counter() - t0
+    assert toks.shape == (clients, gen_tokens)
+
+    put_steps = 8
+    last = {u: int(toks[i, -1]) for i, u in enumerate(uids)}
+    t0 = time.perf_counter()
+    for _ in range(put_steps):
+        logits = engine.put(uids, [[last[u]] for u in uids])
+        last = {u: int(np.argmax(logits[u])) for u in uids}
+    put_decode_s = time.perf_counter() - t0
+    engine.flush(uids)
+
+    steps = gen_tokens
+    tok_s = clients * steps / decode_s
+    p50_ttft = float(np.percentile(ttft_ms, 50))
+    p95_ttft = float(np.percentile(ttft_ms, 95))
+
+    # memory roofline for batched decode on this chip
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    model_bytes = n_params * 2  # bf16 compute copy
+    kind = ""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001
+        pass
+    hbm_bw = 819e9 if ("lite" in kind or "v5e" in kind) else 819e9
+    roofline_tok_s = clients * hbm_bw / model_bytes
+    vs = tok_s / (0.5 * roofline_tok_s)
+
+    print(json.dumps({
+        "metric": "fastgen_decode_tokens_per_sec_125m",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 4),
+        "extra": {
+            "p50_ttft_ms": round(p50_ttft, 2),
+            "p95_ttft_ms": round(p95_ttft, 2),
+            "clients": clients,
+            "prompt_len": prompt_len,
+            "gen_tokens": gen_tokens,
+            "decode_step_ms": round(1000 * decode_s / steps, 2),
+            "put_decode_step_ms": round(1000 * put_decode_s / put_steps, 2),
+            "roofline_tok_s": round(roofline_tok_s, 1),
+            "params_m": round(n_params / 1e6, 1),
+            "platform": jax.devices()[0].platform,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — always emit a JSON record
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({"metric": "fastgen_decode_tokens_per_sec_125m",
+                          "value": 0, "unit": "tokens/s/chip",
+                          "vs_baseline": 0,
+                          "error": f"{type(e).__name__}: {e}"}))

@@ -66,10 +66,18 @@ class InferenceEngineV2:
             self.state_manager.kv_cache.cache = jax.tree.map(
                 lambda x: jax.device_put(x, kv_sh),
                 self.state_manager.kv_cache.cache)
+        # Token-dim buckets: a decode step (a handful of tokens) compiles
+        # to a SMALL program instead of the prefill-sized one — the paged
+        # kernel's grid is proportional to the token capacity, so running
+        # every decode at the full SplitFuse budget costs a prefill's grid
+        # per generated token. Powers-of-4 keeps compile count low.
+        budget = sm_cfg.max_ragged_batch_size
+        self._buckets = sorted({b for b in (16, 64, 256, 1024)
+                                if b < budget} | {budget})
         # donate the KV pool: the old cache is dead the moment
         # state_manager.kv_cache.update() stores the new one, and donation
         # lets XLA update the pool in place instead of copying it per step
-        self._step = jax.jit(model.__call__, donate_argnums=(1,))
+        self._steps: Dict[int, Any] = {}
         log_dist(
             f"InferenceEngineV2: token_budget={sm_cfg.max_ragged_batch_size} "
             f"max_seqs={sm_cfg.max_ragged_sequence_count} "
@@ -146,6 +154,24 @@ class InferenceEngineV2:
                 results[uid] = logits
         return results
 
+    def _get_step(self, bucket: int):
+        """One jitted (model fwd ∘ metadata unpack) program per token
+        bucket; the KV pool is donated."""
+        step = self._steps.get(bucket)
+        if step is None:
+            from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import (
+                unpack_metadata)
+
+            S, B = self._batch.max_seqs, self._max_blocks
+
+            def run(params, cache, packed):
+                batch = unpack_metadata(packed, bucket, S, B)
+                return self.model(params, cache, batch)
+
+            step = jax.jit(run, donate_argnums=(1,))
+            self._steps[bucket] = step
+        return step
+
     def _has_pending(self, uids) -> bool:
         return any(self.state_manager.get_sequence(u) is not None
                    and self.state_manager.get_sequence(u).pending
@@ -175,11 +201,15 @@ class InferenceEngineV2:
         if not scheduled:
             return {}
 
-        meta = self._batch.finalize()
-        device_meta = {k: jnp.asarray(v) for k, v in meta.items()
-                       if k != "n_valid"}
-        logits, new_cache = self._step(self.params, sm.kv_cache.cache,
-                                       device_meta)
+        from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import (
+            pack_metadata)
+
+        bucket = min(b for b in self._buckets
+                     if b >= self._batch.current_tokens)
+        meta = self._batch.finalize(bucket)
+        packed = jnp.asarray(pack_metadata(meta))  # ONE upload
+        logits, new_cache = self._get_step(bucket)(
+            self.params, sm.kv_cache.cache, packed)
         sm.kv_cache.update(new_cache)
 
         out: Dict[int, np.ndarray] = {}
@@ -195,6 +225,130 @@ class InferenceEngineV2:
                         jax.device_get(logits), np.float32)
                 out[uid] = logits_host[slot]
         return out
+
+    # ------------------------------------------------------------------ #
+    # Device-resident greedy decode (TPU-native: the per-put() decode path
+    # pays host<->device round-trips per token — metadata upload, dispatch,
+    # logits download — which dominates on remote-attached accelerators.
+    # decode_loop runs K decode iterations as ONE lax.scan program with
+    # on-device argmax and on-device metadata advance: positions increment
+    # and kv write targets are derived from the block table inside the
+    # program, so the host is only involved once per K tokens.)
+    # ------------------------------------------------------------------ #
+    #: scan-length buckets for decode_loop: arbitrary ``steps`` decomposes
+    #: into at most a handful of compiled programs (greedy largest-first),
+    #: instead of one recompile per distinct max_new_tokens
+    DECODE_CHUNKS = (64, 16, 4, 1)
+
+    def decode_loop(self, uids: Sequence[int], tokens: Sequence[int],
+                    steps: int) -> np.ndarray:
+        """Greedy-decode ``steps`` tokens for live sequences.
+
+        ``tokens[i]`` is sequence ``uids[i]``'s next input token (e.g. the
+        argmax of the logits ``put`` just returned). Returns the generated
+        tokens ``[len(uids), steps]`` (the first column is the token AFTER
+        consuming ``tokens``). Bookkeeping (seen_tokens) is advanced.
+
+        Internally runs scan chunks drawn from :data:`DECODE_CHUNKS` so the
+        set of compiled programs is bounded regardless of ``steps``.
+        """
+        if len(tokens) != len(uids):
+            raise ValueError(
+                f"decode_loop: {len(uids)} uids but {len(tokens)} tokens")
+        if len(uids) > self._batch.max_seqs:
+            raise ValueError(
+                f"decode_loop: {len(uids)} sequences exceed max_seqs "
+                f"{self._batch.max_seqs}")
+        max_context = self.config.state_manager.max_context
+        for uid in uids:
+            seq = self.state_manager.get_sequence(uid)
+            if seq is None or seq.pending:
+                raise RuntimeError(
+                    f"decode_loop: sequence {uid} missing or has pending "
+                    f"prompt tokens — run put() first")
+            if seq.seen_tokens + steps > max_context:
+                raise RuntimeError(
+                    f"decode_loop: sequence {uid} would exceed max_context")
+        outs = []
+        cur = list(tokens)
+        remaining = steps
+        while remaining:
+            k = next(c for c in self.DECODE_CHUNKS if c <= remaining)
+            toks = self._decode_chunk(uids, cur, k)    # [n, k]
+            outs.append(toks)
+            cur = [int(t) for t in toks[:, -1]]
+            remaining -= k
+        return np.concatenate(outs, axis=1)
+
+    def _decode_chunk(self, uids, tokens, steps: int) -> np.ndarray:
+        sm = self.state_manager
+        S, B = self._batch.max_seqs, self._max_blocks
+        seqs = []
+        for uid in uids:
+            seq = sm.get_sequence(uid)
+            sm.maybe_allocate_kv(seq, steps)
+            seqs.append(seq)
+
+        from deepspeed_tpu.inference.v2.ragged.blocked_allocator import (
+            BlockedAllocator)
+
+        trash = BlockedAllocator.TRASH_BLOCK
+        tables = np.full((S, B), trash, np.int32)
+        pos0 = np.zeros((S,), np.int32)
+        tok0 = np.zeros((S,), np.int32)
+        for i, (seq, t) in enumerate(zip(seqs, tokens)):
+            tables[i, :len(seq.blocks)] = seq.blocks
+            pos0[i] = seq.seen_tokens
+            tok0[i] = int(t)
+        packed = jnp.asarray(np.concatenate(
+            [tables.ravel(), pos0, tok0]))         # ONE upload
+        runner = self._get_decode_loop(steps)
+        out_tokens, new_cache = runner(self.params, sm.kv_cache.cache,
+                                       packed)
+        sm.kv_cache.update(new_cache)
+        for seq in seqs:
+            seq.seen_tokens += steps
+        return np.asarray(jax.device_get(out_tokens)).T[:len(uids)]
+
+    def _get_decode_loop(self, steps: int):
+        key = ("decode_loop", steps)
+        runner = self._steps.get(key)
+        if runner is not None:
+            return runner
+        S, B = self._batch.max_seqs, self._max_blocks
+        bs = self.state_manager.block_size
+
+        def run(params, cache, packed):
+            tables = packed[:S * B].reshape(S, B)
+            pos0 = packed[S * B:S * B + S]
+            tok0 = packed[S * B + S:]
+            slot = jnp.arange(S, dtype=jnp.int32)
+
+            def body(carry, _):
+                kv, tok, pos = carry
+                blk = jnp.take_along_axis(
+                    tables, jnp.clip(pos // bs, 0, B - 1)[:, None],
+                    axis=1)[:, 0]
+                batch = {
+                    "token_ids": tok,
+                    "token_slot": slot,
+                    "token_pos": pos,
+                    "kv_dest": blk * bs + pos % bs,
+                    "block_tables": tables,
+                    "context_lens": pos + 1,
+                    "logits_idx": slot,
+                }
+                logits, kv = self.model(params, kv, batch)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (kv, nxt, pos + 1), nxt
+
+            (kv, _, _), toks = jax.lax.scan(
+                body, (cache, tok0, pos0), None, length=steps)
+            return toks, kv                        # toks: [steps, S]
+
+        runner = jax.jit(run, donate_argnums=(1,))
+        self._steps[key] = runner
+        return runner
 
     # ------------------------------------------------------------------ #
     # flush (reference engine_v2.py:210)
@@ -215,6 +369,22 @@ class InferenceEngineV2:
         outs: Dict[int, List[int]] = {u: [] for u in uids}
         live = list(uids)
         logits = self.put(uids, prompts)
+        if eos_token_id is None and max_new_tokens > 1:
+            # no early-exit needed -> device-resident decode: one dispatch
+            # per decode chunk instead of one per token (grouped by
+            # max_seqs — decode_loop batches at most one slot per sequence)
+            first = {u: int(np.argmax(logits[u])) for u in uids}
+            rest: Dict[int, np.ndarray] = {}
+            S = self._batch.max_seqs
+            for g in range(0, len(uids), S):
+                grp = list(uids[g:g + S])
+                toks = self.decode_loop(grp, [first[u] for u in grp],
+                                        max_new_tokens - 1)
+                for i, u in enumerate(grp):
+                    rest[u] = toks[i]
+            self.flush(uids)
+            return [np.asarray([first[u]] + rest[u].tolist(), np.int32)
+                    for u in uids]
         for _ in range(max_new_tokens):
             nxt = {u: int(np.argmax(logits[u])) for u in live}
             for u in live:

@@ -65,10 +65,20 @@ class RaggedBatchWrapper:
         self._chunks.append(np.asarray(tokens, np.int32))
         self._tokens_used += len(tokens)
 
-    def finalize(self):
+    def finalize(self, token_capacity: int = None):
         """Build the device metadata (reference ``finalize``: host->device
-        copy of the packed descriptors)."""
-        T, S, B = self.token_budget, self.max_seqs, self.max_blocks
+        copy of the packed descriptors).
+
+        ``token_capacity`` sizes the token-dim arrays (defaults to the full
+        budget) — the engine passes the active BUCKET so a decode step
+        compiles to a small program instead of the prefill-sized one.
+        """
+        T = token_capacity if token_capacity is not None else self.token_budget
+        if self._tokens_used > T:
+            raise ValueError(
+                f"finalize: {self._tokens_used} scheduled tokens exceed "
+                f"token capacity {T}")
+        S, B = self.max_seqs, self.max_blocks
         bs = self.block_size
         token_ids = np.zeros((T,), np.int32)
         token_slot = np.zeros((T,), np.int32)
@@ -110,3 +120,36 @@ class RaggedBatchWrapper:
     @property
     def chunk_sizes(self) -> List[int]:
         return [len(c) for c in self._chunks]
+
+
+# --------------------------------------------------------------------- #
+# Metadata packing: ONE int32 host->device transfer per forward instead of
+# seven (each upload pays full round-trip latency on remote-tunnel
+# backends; the reference stages through one pinned fast_host_buffer for
+# the same reason)
+# --------------------------------------------------------------------- #
+_META_FIELDS = ("token_ids", "token_slot", "token_pos", "kv_dest",
+                "block_tables", "context_lens", "logits_idx")
+
+
+def pack_metadata(meta) -> np.ndarray:
+    """Flatten the finalize() dict into one int32 vector (host side)."""
+    return np.concatenate(
+        [np.asarray(meta[k], np.int32).ravel() for k in _META_FIELDS])
+
+
+def unpack_metadata(packed, token_capacity: int, max_seqs: int,
+                    max_blocks: int):
+    """Rebuild the batch dict from the packed vector (inside jit)."""
+    T, S, B = token_capacity, max_seqs, max_blocks
+    sizes = {"token_ids": (T, (T,)), "token_slot": (T, (T,)),
+             "token_pos": (T, (T,)), "kv_dest": (T, (T,)),
+             "block_tables": (S * B, (S, B)),
+             "context_lens": (S, (S,)), "logits_idx": (S, (S,))}
+    out = {}
+    o = 0
+    for k in _META_FIELDS:
+        n, shape = sizes[k]
+        out[k] = packed[o:o + n].reshape(shape)
+        o += n
+    return out

@@ -463,3 +463,51 @@ def test_v2_mixtral_matches_cache_free_forward():
     got = eng.generate(prompts, max_new_tokens=6)
     for g, w in zip(got, want):
         np.testing.assert_array_equal(g, np.asarray(w))
+
+
+def test_generate_more_prompts_than_max_seqs():
+    """generate() with more prompts than sequence slots chunks across
+    groups on the device-resident decode path too."""
+    params = _params()
+    eng = _v2_engine(params, token_budget=16, block_size=8, max_seqs=2)
+    rng = np.random.default_rng(8)
+    prompts = [rng.integers(0, CFG.vocab_size, size=(4,)).tolist()
+               for _ in range(3)]
+    ref = _v1_reference_tokens(params, prompts, n_new=5)
+    out = eng.generate(prompts, max_new_tokens=5)
+    for got, want in zip(out, ref):
+        np.testing.assert_array_equal(got, np.asarray(want))
+
+
+def test_decode_loop_validates_lengths():
+    params = _params()
+    eng = _v2_engine(params)
+    eng.put([1, 2], [[3, 4], [5]])
+    with pytest.raises(ValueError, match="tokens"):
+        eng.decode_loop([1, 2], [7], steps=2)
+    eng.flush([1, 2])
+
+
+def test_decode_loop_chunking_matches_put_loop():
+    """steps=7 decomposes into 4+1+1+1 chunks; tokens must equal the
+    per-put() decode loop."""
+    params = _params()
+    rng = np.random.default_rng(12)
+    prompt = rng.integers(0, CFG.vocab_size, size=(6,)).tolist()
+
+    eng1 = _v2_engine(params)
+    logits = eng1.put([1], [prompt])
+    t = int(np.argmax(logits[1]))
+    want = [t]
+    for _ in range(7):
+        logits = eng1.put([1], [[t]])
+        t = int(np.argmax(logits[1]))
+        want.append(t)
+    eng1.flush([1])
+
+    eng2 = _v2_engine(params)
+    logits = eng2.put([1], [prompt])
+    t0 = int(np.argmax(logits[1]))
+    toks = eng2.decode_loop([1], [t0], steps=7)
+    eng2.flush([1])
+    np.testing.assert_array_equal([t0] + toks[0].tolist(), want)
