@@ -108,7 +108,16 @@ def main():
         kind = jax.devices()[0].device_kind.lower()
     except Exception:  # noqa: BLE001
         pass
-    hbm_bw = 819e9 if ("lite" in kind or "v5e" in kind) else 819e9
+    if "v5 lite" in kind or "v5e" in kind:
+        hbm_bw = 819e9
+    elif "v5p" in kind or "v5" in kind:
+        hbm_bw = 2765e9
+    elif "v4" in kind:
+        hbm_bw = 1228e9
+    elif "v6" in kind or "trillium" in kind:
+        hbm_bw = 1640e9
+    else:
+        hbm_bw = 819e9  # conservative default
     roofline_tok_s = clients * hbm_bw / model_bytes
     vs = tok_s / (0.5 * roofline_tok_s)
 

@@ -137,10 +137,6 @@ class InferenceEngine:
             from deepspeed_tpu.runtime.weight_quantizer import (
                 WeightQuantization)
 
-            if self.topology.model_parallel_size > 1:
-                raise NotImplementedError(
-                    "weight-quantized inference currently requires tp=1 "
-                    "(quantized records are not TP-sliced yet)")
             self._weight_quantizer = WeightQuantization(
                 quantize_bits=int(qcfg.get("num_bits", 8)),
                 quantize_groups=int(qcfg.get("num_groups", 64)))
@@ -202,23 +198,45 @@ class InferenceEngine:
         if self._weight_quantizer is not None:
             # leaf-by-leaf from host: each matrix is quantized and only the
             # int8 record lands in HBM — the full-precision tree is never
-            # device-resident (the point of weight-only serving)
+            # device-resident (the point of weight-only serving). Records
+            # are TP-SLICED: q carries the weight's own TP sharding; the
+            # scale is groups-sharded for row-parallel weights (groups are
+            # aligned to the shard count) and replicated for column-parallel
+            # ones (a group never spans columns — see quantize_leaf).
             wq = self._weight_quantizer
+            slicer = self._param_sharding(host_params)
+            mesh = self.mesh
             count = 0
             flat, treedef = jax.tree_util.tree_flatten_with_path(host_params)
             placed_leaves = []
             for path, leaf in flat:
                 arr = np.asarray(leaf)
+                sharding = slicer.sharding_for_path(path)
                 if wq.should_quantize(arr):
+                    spec = sharding.spec
+                    d0 = spec[0] if len(spec) > 0 else None
+                    d0_axes = ((d0,) if isinstance(d0, str)
+                               else tuple(d0 or ()))
+                    tp_mult = 1
+                    for a in d0_axes:
+                        tp_mult *= mesh.shape[a]
                     rec = wq.quantize_leaf(
                         jnp.asarray(arr),
-                        wq.groups_for(wq.leaf_name(path)))
-                    placed_leaves.append(jax.tree.map(jax.device_put, rec))
+                        wq.groups_for(wq.leaf_name(path)),
+                        align=tp_mult)
+                    scale_spec = P(d0) if (
+                        tp_mult > 1
+                        and rec["scale"].shape[0] % tp_mult == 0) else P()
+                    placed_leaves.append({
+                        "q": jax.device_put(rec["q"], sharding),
+                        "scale": jax.device_put(
+                            rec["scale"], NamedSharding(mesh, scale_spec)),
+                    })
                     count += 1
                 else:
-                    placed_leaves.append(jax.device_put(cast(arr)))
-            log_dist(f"InferenceEngine: quantized {count} weight matrices",
-                     ranks=[0])
+                    placed_leaves.append(jax.device_put(cast(arr), sharding))
+            log_dist(f"InferenceEngine: quantized {count} weight matrices "
+                     f"(tp={self.mp_world_size})", ranks=[0])
             self.params = jax.tree_util.tree_unflatten(treedef,
                                                        placed_leaves)
             return
@@ -238,10 +256,9 @@ class InferenceEngine:
             lambda r: self.module.init(r, sample_ids)["params"],
             out_shardings=shardings)(rng)
         if self._weight_quantizer is not None:
-            self.params, count = self._weight_quantizer.model_quantize(
-                self.params)
-            log_dist(f"InferenceEngine: quantized {count} weight matrices",
-                     ranks=[0])
+            # route through _place_params so records get the same TP-sliced
+            # layout as checkpoint loading (test/smoke path — tiny models)
+            self._place_params(jax.device_get(self.params))
         return self.params
 
     def _ensure_params(self, ids):

@@ -1,5 +1,5 @@
 """Data-efficiency pipeline (reference: runtime/data_pipeline/ —
-curriculum learning + random-LTD data routing)."""
+curriculum learning + random-LTD data routing + data_sampling analysis)."""
 
 from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
     CurriculumScheduler,

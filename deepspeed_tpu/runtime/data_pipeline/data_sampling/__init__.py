@@ -1,0 +1,23 @@
+"""Data sampling (reference: runtime/data_pipeline/data_sampling/ —
+DataAnalyzer map/reduce, mmap indexed dataset, curriculum data sampler)."""
+
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+    DataAnalyzer,
+    MetricIndex,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import (
+    CurriculumDataLoader,
+    DeepSpeedDataSampler,
+    build_curriculum_loader,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    make_builder,
+    make_dataset,
+)
+
+__all__ = ["DataAnalyzer", "MetricIndex", "CurriculumDataLoader",
+           "DeepSpeedDataSampler", "build_curriculum_loader",
+           "MMapIndexedDataset", "MMapIndexedDatasetBuilder",
+           "make_builder", "make_dataset"]

@@ -1,0 +1,125 @@
+"""Offline dataset analysis (reference:
+runtime/data_pipeline/data_sampling/data_analyzer.py ``DataAnalyzer`` —
+map/reduce of per-sample difficulty metrics, producing the index files the
+curriculum sampler consumes).
+
+Map: each worker computes ``metric_fn(sample)`` for its shard of the
+dataset and writes a partial ``sample_to_metric`` array. Reduce: partials
+are merged and inverted into a CSR ``metric -> samples`` map:
+
+``<save>/<metric>/sample_to_metric.npy``  int64[n_samples]
+``<save>/<metric>/metric_values.npy``     sorted unique metric values
+``<save>/<metric>/metric_offsets.npy``    CSR offsets into sample ids
+``<save>/<metric>/metric_to_sample.npy``  sample ids grouped by value
+
+The CSR layout makes the sampler's eligibility query ("all samples with
+metric <= difficulty") one ``searchsorted`` + one slice.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class DataAnalyzer:
+    """reference data_analyzer.py:DataAnalyzer (map/reduce driver)."""
+
+    def __init__(self, dataset: Any,
+                 metric_names: Sequence[str],
+                 metric_functions: Sequence[Callable[[Any], float]],
+                 metric_types: Optional[Sequence[str]] = None,
+                 save_path: str = "./data_analysis",
+                 num_workers: int = 1, worker_id: int = 0):
+        if len(metric_names) != len(metric_functions):
+            raise ValueError("metric_names/metric_functions length mismatch")
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.metric_types = list(metric_types or
+                                 ["single_value_per_sample"] * len(metric_names))
+        for t in self.metric_types:
+            if t != "single_value_per_sample":
+                raise ValueError(
+                    f"metric type {t!r} not supported (reference also has "
+                    f"accumulate_value_over_samples for dataset-level stats)")
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+
+    # ------------------------------ map ------------------------------- #
+    def _shard_range(self):
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        lo = self.worker_id * per
+        return lo, min(lo + per, n)
+
+    def _part_file(self, metric: str, worker: int) -> str:
+        return os.path.join(self.save_path, metric,
+                            f"part_{worker:05d}.npy")
+
+    def run_map(self) -> None:
+        """Compute this worker's shard of every metric."""
+        lo, hi = self._shard_range()
+        for name, fn in zip(self.metric_names, self.metric_functions):
+            vals = np.asarray([fn(self.dataset[i]) for i in range(lo, hi)],
+                              np.int64)
+            os.makedirs(os.path.join(self.save_path, name), exist_ok=True)
+            np.save(self._part_file(name, self.worker_id), vals)
+        log_dist(f"DataAnalyzer map: worker {self.worker_id} analyzed "
+                 f"samples [{lo}, {hi})", ranks=[0])
+
+    # ----------------------------- reduce ----------------------------- #
+    def run_reduce(self) -> None:
+        """Merge worker partials into the CSR metric index files."""
+        for name in self.metric_names:
+            parts = [np.load(self._part_file(name, w))
+                     for w in range(self.num_workers)]
+            sample_to_metric = np.concatenate(parts)
+            d = os.path.join(self.save_path, name)
+            np.save(os.path.join(d, "sample_to_metric.npy"),
+                    sample_to_metric)
+            order = np.argsort(sample_to_metric, kind="stable")
+            values = sample_to_metric[order]
+            uniq, starts = np.unique(values, return_index=True)
+            offsets = np.append(starts, len(values)).astype(np.int64)
+            np.save(os.path.join(d, "metric_values.npy"), uniq)
+            np.save(os.path.join(d, "metric_offsets.npy"), offsets)
+            np.save(os.path.join(d, "metric_to_sample.npy"),
+                    order.astype(np.int64))
+        log_dist(f"DataAnalyzer reduce: wrote indices for "
+                 f"{self.metric_names} under {self.save_path}", ranks=[0])
+
+    def run_map_reduce(self) -> None:
+        """Single-process convenience: map every shard, then reduce."""
+        orig = self.worker_id
+        for w in range(self.num_workers):
+            self.worker_id = w
+            self.run_map()
+        self.worker_id = orig
+        self.run_reduce()
+
+
+class MetricIndex:
+    """Reader for one analyzed metric (the sampler's view)."""
+
+    def __init__(self, save_path: str, metric: str):
+        d = os.path.join(save_path, metric)
+        self.sample_to_metric = np.load(
+            os.path.join(d, "sample_to_metric.npy"))
+        self.values = np.load(os.path.join(d, "metric_values.npy"))
+        self.offsets = np.load(os.path.join(d, "metric_offsets.npy"))
+        self.samples = np.load(os.path.join(d, "metric_to_sample.npy"))
+
+    def eligible(self, max_difficulty: float) -> np.ndarray:
+        """Sample ids with metric <= max_difficulty (one searchsorted)."""
+        k = int(np.searchsorted(self.values, max_difficulty, side="right"))
+        return self.samples[:int(self.offsets[k])]
+
+    @property
+    def max_value(self):
+        return self.values[-1] if len(self.values) else 0

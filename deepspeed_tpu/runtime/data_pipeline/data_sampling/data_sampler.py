@@ -1,0 +1,113 @@
+"""Curriculum-driven data sampler (reference:
+runtime/data_pipeline/data_sampling/data_sampler.py
+``DeepSpeedDataSampler`` — selects each global batch from the pool of
+samples whose difficulty metric is within the curriculum scheduler's
+current difficulty).
+
+Where the reference coordinates a per-rank torch sampler over process
+groups, the TPU build samples GLOBAL batches on the host (the engine
+shards each batch over the mesh at device_put), so the sampler is a plain
+deterministic iterator: step t draws from rng(seed, t) over the eligible
+pool — identical on every host, no communication.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+    MetricIndex,
+)
+
+
+class DeepSpeedDataSampler:
+    """Yields per-step GLOBAL batches of sample indices, eligibility-filtered
+    by the live curriculum difficulty."""
+
+    def __init__(self, metric_index: MetricIndex,
+                 batch_size: int,
+                 curriculum_scheduler: CurriculumScheduler,
+                 seed: int = 0,
+                 drop_duplicates_within_step: bool = True):
+        self.index = metric_index
+        self.batch_size = batch_size
+        self.scheduler = curriculum_scheduler
+        self.seed = seed
+        self.step = 0
+        self._dedup = drop_duplicates_within_step
+
+    def set_step(self, step: int) -> None:
+        self.step = step
+
+    def next_batch(self) -> np.ndarray:
+        """Indices for the next global batch at the CURRENT difficulty."""
+        difficulty = self.scheduler.get_current_difficulty()
+        pool = self.index.eligible(difficulty)
+        if len(pool) == 0:
+            raise RuntimeError(
+                f"curriculum difficulty {difficulty} admits no samples "
+                f"(min metric value {self.index.values[:1]})")
+        rng = np.random.default_rng((self.seed, self.step))
+        replace = (not self._dedup) or len(pool) < self.batch_size
+        idx = rng.choice(pool, size=self.batch_size, replace=replace)
+        self.step += 1
+        return idx
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.next_batch()
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"step": self.step, "seed": self.seed}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.step = int(sd["step"])
+        self.seed = int(sd["seed"])
+
+
+class CurriculumDataLoader:
+    """Loader over a map-style dataset driven by a
+    :class:`DeepSpeedDataSampler` — one collated global batch per step,
+    difficulty re-read LIVE each batch (the engine advances the shared
+    scheduler at optimizer-step boundaries)."""
+
+    def __init__(self, dataset: Any, sampler: DeepSpeedDataSampler,
+                 collate_fn=None):
+        from deepspeed_tpu.runtime.dataloader import _default_collate
+
+        self.dataset = dataset
+        self.sampler = sampler
+        self.collate_fn = collate_fn or _default_collate
+        self.batch_size = sampler.batch_size
+
+    def __iter__(self):
+        while True:
+            idx = self.sampler.next_batch()
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])
+
+
+def build_curriculum_loader(dataset: Any, engine, metric_path: str,
+                            metric_name: str,
+                            batch_size: Optional[int] = None,
+                            collate_fn=None,
+                            seed: Optional[int] = None):
+    """Wire a dataset + analyzed metric into the engine's curriculum
+    (reference deepspeed_io hookup, engine.py:1680): the sampler shares the
+    ENGINE's CurriculumScheduler, so difficulty advances as training steps.
+    """
+    if engine.curriculum_scheduler is None:
+        raise ValueError(
+            "engine has no curriculum scheduler — enable "
+            "data_efficiency.data_sampling.curriculum_learning (or "
+            "curriculum_learning) in the config")
+    sampler = DeepSpeedDataSampler(
+        MetricIndex(metric_path, metric_name),
+        batch_size=batch_size or engine.config.train_batch_size,
+        curriculum_scheduler=engine.curriculum_scheduler,
+        seed=engine.config.seed if seed is None else seed)
+    return CurriculumDataLoader(dataset, sampler, collate_fn=collate_fn)

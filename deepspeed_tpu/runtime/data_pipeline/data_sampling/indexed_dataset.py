@@ -1,0 +1,132 @@
+"""Memory-mapped indexed dataset (reference:
+runtime/data_pipeline/data_sampling/indexed_dataset.py — the Megatron-style
+``.bin``/``.idx`` binary format the DataAnalyzer and curriculum sampler
+read and write).
+
+TPU-native stance: this is host-side IO, so the design goal is zero-copy
+reads — the ``.bin`` payload is a single ``np.memmap`` and ``__getitem__``
+returns views into it (no per-sample allocation), which is what a host
+input pipeline feeding ``device_put`` wants.
+
+Format (little-endian):
+
+``.idx``: magic ``b"DSTPUIDX"`` | version u64 | dtype-code u8 |
+          n_items u64 | sizes u32[n] | pointers u64[n]
+``.bin``: raw item payloads, concatenated.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any, Sequence
+
+import numpy as np
+
+MAGIC = b"DSTPUIDX"
+VERSION = 1
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+           6: np.float32, 7: np.float64, 8: np.uint16, 9: np.uint32}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer (reference ``MMapIndexedDatasetBuilder``)."""
+
+    def __init__(self, prefix: str, dtype=np.int32):
+        self.prefix = prefix
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        self._bin = open(data_file_path(prefix), "wb")
+        self._sizes = []
+        self._pointers = []
+        self._offset = 0
+
+    def add_item(self, arr: Any) -> None:
+        arr = np.ascontiguousarray(np.asarray(arr, dtype=self.dtype))
+        self._pointers.append(self._offset)
+        self._sizes.append(arr.size)
+        self._bin.write(arr.tobytes())
+        self._offset += arr.nbytes
+
+    def merge_file_(self, other_prefix: str) -> None:
+        """Append another builder's output (reference parallel-writer merge)."""
+        other = MMapIndexedDataset(other_prefix)
+        for i in range(len(other)):
+            self.add_item(other[i])
+
+    def finalize(self) -> None:
+        self._bin.close()
+        with open(index_file_path(self.prefix), "wb") as f:
+            f.write(MAGIC)
+            f.write(struct.pack("<Q", VERSION))
+            f.write(struct.pack("<B", _DTYPE_CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(self._sizes)))
+            f.write(np.asarray(self._sizes, np.uint32).tobytes())
+            f.write(np.asarray(self._pointers, np.uint64).tobytes())
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader (reference ``MMapIndexedDataset``)."""
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+        with open(index_file_path(prefix), "rb") as f:
+            magic = f.read(len(MAGIC))
+            if magic != MAGIC:
+                raise ValueError(f"{index_file_path(prefix)}: bad magic "
+                                 f"{magic!r}")
+            (version,) = struct.unpack("<Q", f.read(8))
+            if version != VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            (code,) = struct.unpack("<B", f.read(1))
+            self.dtype = np.dtype(_DTYPES[code])
+            (n,) = struct.unpack("<Q", f.read(8))
+            self.sizes = np.frombuffer(f.read(4 * n), np.uint32)
+            self.pointers = np.frombuffer(f.read(8 * n), np.uint64)
+        if os.path.getsize(data_file_path(prefix)) == 0:
+            # np.memmap refuses empty files; an empty shard is valid
+            # (a parallel preprocessing worker with no input)
+            self._data = np.zeros((0,), np.uint8)
+        else:
+            self._data = np.memmap(data_file_path(prefix), mode="r",
+                                   dtype=np.uint8)
+
+    def __len__(self) -> int:
+        return len(self.sizes)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        ptr = int(self.pointers[i])
+        size = int(self.sizes[i])
+        return np.frombuffer(self._data, dtype=self.dtype, count=size,
+                             offset=ptr)
+
+    @staticmethod
+    def exists(prefix: str) -> bool:
+        return (os.path.exists(data_file_path(prefix))
+                and os.path.exists(index_file_path(prefix)))
+
+
+def make_builder(prefix: str, impl: str = "mmap", dtype=np.int32):
+    """reference ``make_builder`` surface (impl kept for parity; only the
+    mmap implementation exists — cached/lazy are torch-IO artifacts)."""
+    del impl
+    return MMapIndexedDatasetBuilder(prefix, dtype=dtype)
+
+
+def make_dataset(prefix: str, impl: str = "mmap"):
+    """reference ``make_dataset`` surface."""
+    del impl
+    return MMapIndexedDataset(prefix)

@@ -8,15 +8,18 @@ sign-compressed *momentum* via :func:`compressed_allreduce` (error feedback
 keeps the running average unbiased). Communication volume drops ~32x
 (fp32 → 1 bit + scales).
 
-Engine integration (both programs require a pure data-parallel mesh and
-ZeRO stage 0 — params/grads replicated, matching the reference's
-1-bit/ZeRO incompatibility):
+Engine integration (both programs require a pure data-parallel mesh; ZeRO
+stage 0 or 1 — the reference pairing):
 
 * :func:`build_local_grad_micro` — micro-step whose accumulated gradients
   keep a leading ``[W, ...]`` device axis (sharded over dp) and are NOT
   cross-device reduced: the optimizer owns communication.
-* :func:`build_compressed_apply` — shard_map optimizer step: local momentum
-  update → 1-bit allreduce → frozen-variance Adam/LAMB update.
+* :func:`build_compressed_apply` — shard_map optimizer step. Stage 0:
+  local momentum update → 1-bit momentum allreduce → frozen-variance
+  Adam/LAMB update (the reference algorithm). Stage 1 (ZeRO-1): master +
+  moments stay dp-SHARDED; the 1-bit error-feedback allreduce carries the
+  GRADIENT, each device updates only its block, and the bf16 compute
+  params are rebuilt with the ZeRO-1 param all-gather.
 
 The warmup phase reuses the engine's standard apply with the grads averaged
 over the device axis (full-precision comm, as the reference does).
@@ -37,6 +40,8 @@ from deepspeed_tpu.ops.optimizers import (OptimizerDef, _tree_zeros_like,
                                           register_optimizer)
 from deepspeed_tpu.parallel.topology import GROUP_ALIASES
 from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+from deepspeed_tpu.runtime.zero.zeropp import (block_index, find_shard_dim,
+                                               gather_blocks)
 
 ONEBIT_NAMES = ("onebitadam", "onebitlamb", "zerooneadam")
 DP_AXES = ("dout", "data")
@@ -115,11 +120,12 @@ def validate_onebit_mesh(engine) -> int:
             raise ValueError(
                 f"1-bit optimizers require a pure data-parallel mesh "
                 f"(got {axis}={topo.get_dim(axis)})")
-    if engine.zero_stage != 0:
+    if engine.zero_stage > 1:
         raise ValueError(
             "1-bit optimizers own gradient communication and are "
-            "incompatible with ZeRO sharding (reference constraint); set "
-            "zero_optimization.stage to 0")
+            "incompatible with ZeRO gradient/param sharding (reference "
+            "pairs them with stage 0 or 1); set zero_optimization.stage "
+            "to 0 or 1")
     return topo.get_dim("dout") * topo.get_dim("data")
 
 
@@ -200,11 +206,15 @@ def build_compressed_apply(engine, update_variance: bool = False):
 
     spec_of = lambda tree: jax.tree.map(lambda s: s.spec, tree)
     state_specs = {k: spec_of(v) for k, v in sh.items()}
+    stage1 = engine.zero_stage == 1
+    master_specs = state_specs["master"]
 
     def apply_local(state, lr):
         inv = 1.0 / state["loss_scale"]
 
         def leaf_step(acc, m, v, p, werr, serr):
+            """Stage 0 (reference 1-bit Adam): sign-compressed MOMENTUM
+            allreduce; m/v/master replicated."""
             g = acc[0] * inv                       # local accumulated grad
             m_local = b1 * m + (1.0 - b1) * g
             n = m_local.size
@@ -229,20 +239,86 @@ def build_compressed_apply(engine, update_variance: bool = False):
             return (p_new, m_avg, v_new, jnp.zeros_like(acc),
                     new_w[None], new_s[None])
 
-        out = jax.tree.map(leaf_step, state["acc_grads"],
-                           state["opt"]["m"], state["opt"]["v"],
-                           state["master"], state["comm_error_worker"],
-                           state["comm_error_server"])
+        def leaf_step_zero1(acc, m, v, p, werr, serr, mspec):
+            """Stage 1 (reference ZeRO-1 x 1-bit pairing): m/v/master are
+            dp-SHARDED; the 1-bit error-feedback allreduce carries the
+            GRADIENT, each device updates only its block, and the bf16
+            params are rebuilt with a plain all-gather (the ZeRO-1 param
+            gather). Variance stays frozen in the compression stage, as in
+            the momentum path."""
+            g = acc[0] * inv
+            n = g.size
+            npad = werr.shape[1]
+            flat = jnp.pad(g.reshape(-1), (0, npad - n))
+            g_avg, new_w, new_s = compressed_allreduce(
+                flat, werr[0], serr[0], DP_AXES)
+            g_avg = g_avg[:n].reshape(g.shape)
+            d, axes = find_shard_dim(mspec, DP_AXES)
+            if d is not None:
+                idx, wa = block_index(axes)
+                blk = g_avg.shape[d] // wa
+                g_blk = lax.dynamic_slice_in_dim(g_avg, idx * blk, blk,
+                                                 axis=d)
+            else:
+                g_blk = g_avg
+            m_new = b1 * m + (1.0 - b1) * g_blk
+            v_new = b2 * v + (1.0 - b2) * m_new * m_new if update_variance \
+                else v
+            stepval = m_new / (jnp.sqrt(v_new) + eps)
+            if wd > 0.0:
+                stepval = stepval + wd * p
+            if lamb:
+                w_norm = jnp.sqrt(lax.psum(jnp.sum(jnp.square(p)), DP_AXES)
+                                  if d is not None else
+                                  jnp.sum(jnp.square(p)))
+                u_norm = jnp.sqrt(
+                    lax.psum(jnp.sum(jnp.square(stepval)), DP_AXES)
+                    if d is not None else jnp.sum(jnp.square(stepval)))
+                ratio = jnp.where(
+                    (w_norm > 0) & (u_norm > 0),
+                    jnp.clip(w_norm / u_norm, min_c, max_c), 1.0)
+                stepval = ratio * stepval
+            p_new = p - lr * stepval
+            return (p_new, m_new, v_new, jnp.zeros_like(acc),
+                    new_w[None], new_s[None])
+
+        if stage1:
+            out = jax.tree.map(
+                leaf_step_zero1, state["acc_grads"],
+                state["opt"]["m"], state["opt"]["v"],
+                state["master"], state["comm_error_worker"],
+                state["comm_error_server"], master_specs,
+                is_leaf=lambda x: isinstance(x, P))
+        else:
+            out = jax.tree.map(leaf_step, state["acc_grads"],
+                               state["opt"]["m"], state["opt"]["v"],
+                               state["master"], state["comm_error_worker"],
+                               state["comm_error_server"])
         pick = lambda i: jax.tree.map(lambda o: o[i], out,
                                       is_leaf=lambda x: isinstance(x, tuple))
         new_master = pick(0)
-        # overflow guard (fp16): keep old state on non-finite update
-        finite = jnp.all(jnp.asarray(
+        # overflow guard (fp16): keep old state on non-finite update.
+        # Cross-device AND — at stage 1 each device sees only its blocks
+        finite_local = jnp.all(jnp.asarray(
             [jnp.all(jnp.isfinite(l)) for l in jax.tree.leaves(new_master)]))
+        finite = lax.pmin(finite_local.astype(jnp.int32), DP_AXES) > 0
         keep = lambda new, old: jax.tree.map(
             lambda a, b: jnp.where(finite, a, b), new, old)
-        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(l))
-                             for l in jax.tree.leaves(pick(1))))
+        if stage1:
+            # psum must not multiply-count leaves whose master stayed
+            # replicated (no dp-sharded dim): weight them by 1/world
+            def leaf_sumsq(m_leaf, mspec):
+                s = jnp.sum(jnp.square(m_leaf))
+                d, _axes = find_shard_dim(mspec, DP_AXES)
+                return s / world if d is None else s
+
+            parts = jax.tree.map(leaf_sumsq, pick(1), master_specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+            gnorm = jnp.sqrt(lax.psum(
+                sum(jax.tree.leaves(parts)), DP_AXES))
+        else:
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(l))
+                                 for l in jax.tree.leaves(pick(1))))
         # dynamic loss scale bookkeeping — same rule as the engine's
         # standard apply (overflow drains hysteresis, then halves)
         overflow = ~finite
@@ -259,15 +335,25 @@ def build_compressed_apply(engine, update_variance: bool = False):
             full = jnp.asarray(fp16_cfg.hysteresis, jnp.int32)
             hyst = jnp.where(overflow, jnp.maximum(hyst - 1, 1),
                              jnp.where(grow, full, hyst))
+        kept_master = keep(new_master, state["master"])
+
+        def to_param(m_leaf, mspec):
+            # stage 1: rebuild the replicated bf16 compute copy from the
+            # dp-sharded master blocks (the ZeRO-1 param all-gather)
+            if stage1:
+                d, axes = find_shard_dim(mspec, DP_AXES)
+                if d is not None:
+                    m_leaf = gather_blocks(m_leaf, axes, d)
+            return m_leaf.astype(compute_dtype)
+
         new_state = dict(state)
         new_state.update({
             "step": state["step"] + 1,
             "opt_step": jnp.where(finite, state["opt_step"] + 1,
                                   state["opt_step"]),
-            "master": keep(new_master, state["master"]),
-            "params": jax.tree.map(
-                lambda m_: m_.astype(compute_dtype),
-                keep(new_master, state["master"])),
+            "master": kept_master,
+            "params": jax.tree.map(to_param, kept_master, master_specs,
+                                   is_leaf=lambda x: isinstance(x, P)),
             "opt": {"m": keep(pick(1), state["opt"]["m"]),
                     "v": keep(pick(2), state["opt"]["v"])},
             "acc_grads": pick(3),

@@ -16,9 +16,8 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from deepspeed_tpu.ops.quantizer import dequantize, quantize
+from deepspeed_tpu.ops.quantizer import quantize
 
 
 class WeightQuantization:
@@ -49,15 +48,32 @@ class WeightQuantization:
         return g
 
 
-    def quantize_leaf(self, w: jnp.ndarray, groups: int
+    def quantize_leaf(self, w: jnp.ndarray, groups: int, align: int = 1
                       ) -> Dict[str, jnp.ndarray]:
         """Record = {q: int8 in the WEIGHT'S shape, scale: [groups]} —
         all-array records flow through jit as plain pytrees (the original
-        shape travels with q itself)."""
-        n = int(np.prod(w.shape))
-        while n % groups != 0:
-            groups //= 2
-        q, scale, _ = quantize(w, max(groups, 1), self.quantize_bits, True)
+        shape travels with q itself).
+
+        Groups are blocks of LEADING-dim rows (groups | dim0), so a record
+        is TP-sliceable: a dim-0 (row-parallel) shard of ``q`` owns whole
+        groups when ``groups`` is a multiple of the shard count (pass it as
+        ``align``), and a dim-1 shard never splits a group at all (scale
+        broadcasts over trailing dims). This is the "slice before quantize,
+        per-shard groups" layout of the reference's sharded checkpoints.
+        """
+        rows = int(w.shape[0])
+        groups = max(1, min(groups, rows))
+        align = max(1, align)
+        if rows % align == 0:
+            # largest multiple of `align` that divides rows, <= wanted size
+            g = (groups // align) * align
+            while g >= align and rows % g != 0:
+                g -= align
+            groups = g if g >= align else align
+        else:  # cannot align (leaf not actually dim-0 sharded)
+            while rows % groups != 0:
+                groups -= 1
+        q, scale, _ = quantize(w, groups, self.quantize_bits, True)
         return {"q": q.reshape(w.shape), "scale": scale}
 
     def model_quantize(self, params: Any,
@@ -88,12 +104,18 @@ class WeightQuantization:
     def dequantize_tree(self, tree: Any, dtype=jnp.bfloat16) -> Any:
         def one(leaf):
             if self.is_quantized_record(leaf):
-                shape = leaf["q"].shape
-                groups = leaf["scale"].shape[0]
-                return dequantize(leaf["q"].reshape(groups, -1),
-                                  leaf["scale"],
-                                  num_bits=self.quantize_bits,
-                                  dtype=dtype).reshape(shape)
+                q, scale = leaf["q"], leaf["scale"]
+                shape = q.shape
+                g = scale.shape[0]
+                # split ONLY dim 0 into (groups, rows/groups) and broadcast
+                # the scale — trailing dims are untouched, so a TP-sharded
+                # record dequantizes with zero resharding under GSPMD
+                # (column shards see a replicated scale; row shards own
+                # whole groups)
+                q3 = q.reshape((g, shape[0] // g) + shape[1:])
+                exp = scale.reshape((g,) + (1,) * (q3.ndim - 1))
+                return (q3.astype(jnp.float32) * exp).astype(dtype) \
+                    .reshape(shape)
             return leaf
 
         return jax.tree.map(one, tree,

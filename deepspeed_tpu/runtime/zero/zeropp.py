@@ -20,11 +20,15 @@ data-parallel axes in which
   replica axes are mean-reduced at shard volume — with hpZ/MiCS meshes this
   reproduces the reference's hierarchical intra-node/inter-node split.
 
-The manual program requires the non-ZeRO axes to be trivial
-(model = seq = expert = pipe = 1): quantized communication composes with
-hpZ/MiCS (dout×data) but not — yet — with in-model collectives, which the
-auto-sharded path owns. The engine raises loudly otherwise rather than
-silently ignoring the knobs.
+**Composition with model parallelism** (the reference's flagship 3D config:
+ZeRO++ × Megatron TP, blogs/zeropp/): the program is a *partially manual*
+``shard_map`` — manual over the data-parallel axes ``('dout','data')`` where
+the explicit int8 collectives live, while ``model``/``seq``/``expert`` stay
+**auto**: GSPMD keeps inserting the in-model collectives (TP all-reduces,
+Ulysses all-to-alls, expert dispatch) inside the body exactly as in the
+non-quantized path. Only ``pipe`` must be trivial (the pipeline engine owns
+its own programs); the engine raises loudly for it rather than silently
+ignoring the knobs.
 """
 
 from __future__ import annotations
@@ -41,6 +45,9 @@ from deepspeed_tpu.ops.quantizer import dequantize, quantize, quantized_reduce
 from deepspeed_tpu.parallel.topology import GROUP_ALIASES
 
 DEFAULT_GROUP_SIZE = 256
+#: axes the quantized-collective program is MANUAL over; everything else
+#: (model/seq/expert) stays auto so GSPMD composes in-model collectives
+MANUAL_AXES = ("dout", "data")
 
 
 def _axes_of_entry(entry) -> Tuple[str, ...]:
@@ -51,8 +58,10 @@ def _axes_of_entry(entry) -> Tuple[str, ...]:
     return tuple(entry)
 
 
-def _find_shard_dim(spec: P, candidates: Sequence[str]):
-    """(dim, axes) of the first spec entry touching any candidate axis."""
+def find_shard_dim(spec: P, candidates: Sequence[str]):
+    """(dim, axes) of the first spec entry touching any candidate axis.
+    Shared by the quantized-collective program and the 1-bit stage-1
+    optimizer — the single source of truth for shard-dim resolution."""
     if spec is None:
         return None, ()
     for d, entry in enumerate(spec):
@@ -60,6 +69,32 @@ def _find_shard_dim(spec: P, candidates: Sequence[str]):
         if axes:
             return d, axes
     return None, ()
+
+
+_find_shard_dim = find_shard_dim  # backwards-compat alias
+
+
+def block_index(axis_names) -> Tuple[jnp.ndarray, int]:
+    """(flat block index of this device, total blocks) over ``axis_names``
+    in mesh-major order — matches a PartitionSpec entry of the same axis
+    tuple. Call inside shard_map."""
+    idx = jnp.int32(0)
+    world = 1
+    for a in axis_names:
+        world *= lax.axis_size(a)
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx, world
+
+
+def gather_blocks(x: jnp.ndarray, axis_names, shard_dim: int) -> jnp.ndarray:
+    """Reassemble a dim-sharded local block into the full tensor with one
+    all-gather (inverse of the PartitionSpec slicing). Call inside
+    shard_map."""
+    g = lax.all_gather(x, axis_names)
+    full = jnp.moveaxis(g, 0, shard_dim)
+    shape = list(x.shape)
+    shape[shard_dim] *= g.shape[0]
+    return full.reshape(shape)
 
 
 def _pad_to(x: jnp.ndarray, multiple: int) -> Tuple[jnp.ndarray, int]:
@@ -155,45 +190,57 @@ def build_quantized_micro(engine) -> Any:
     zero_quantized_weights / zero_quantized_gradients is on).
     """
     topo = engine.topology
-    for axis in ("model", "seq", "expert", "pipe"):
-        if topo.get_dim(axis) != 1:
-            raise ValueError(
-                "ZeRO++ quantized communication currently requires "
-                f"model/seq/expert/pipe parallel degrees of 1 (got {axis}="
-                f"{topo.get_dim(axis)}): in-model collectives belong to the "
-                "auto-sharded path")
+    if topo.get_dim("pipe") != 1:
+        raise ValueError(
+            "ZeRO++ quantized communication requires pipe parallel degree 1 "
+            f"(got pipe={topo.get_dim('pipe')}): the pipeline engine owns "
+            "its own micro programs")
 
     zc = engine.config.zero_config
     qw = bool(zc.zero_quantized_weights) and engine.zero_stage >= 3
     qg = bool(zc.zero_quantized_gradients)
-    dp_axes = ("dout", "data")
+    dp_axes = MANUAL_AXES
     mesh = engine.mesh
     sh = engine._state_shardings()
     gas = engine._grad_accum_divisor()
 
     param_specs = jax.tree.map(lambda s: s.spec, sh["params"])
     grad_specs = jax.tree.map(lambda s: s.spec, sh["acc_grads"])
-    batch_spec = P(GROUP_ALIASES["dp"])
+
+    def _strip_auto(spec: P) -> P:
+        """Keep only the MANUAL axes of a spec — the shard_map in/out specs
+        describe the manual axes; auto (model/seq/expert) sharding rides on
+        the values themselves and GSPMD keeps handling it inside the body."""
+        if spec is None:
+            return P()
+        entries = []
+        for e in spec:
+            axes = tuple(a for a in _axes_of_entry(e) if a in dp_axes)
+            entries.append(axes if len(axes) > 1
+                           else (axes[0] if axes else None))
+        return P(*entries)
+
+    strip_tree = lambda t: jax.tree.map(_strip_auto, t,
+                                        is_leaf=lambda x: isinstance(x, P))
+    param_specs_manual = strip_tree(param_specs)
+    grad_specs_manual = strip_tree(grad_specs)
+    batch_spec = _strip_auto(P(GROUP_ALIASES["dp"]))
 
     def gather_params(params_local):
         def one(p, spec):
-            d, axes = _find_shard_dim(spec, dp_axes)
+            d, axes = find_shard_dim(spec, dp_axes)
             if d is None:
                 return p
             if qw:
                 return quantized_all_gather(p, axes, d)
-            g = lax.all_gather(p, axes)
-            full = jnp.moveaxis(g, 0, d)
-            shape = list(p.shape)
-            shape[d] *= g.shape[0]
-            return full.reshape(shape)
+            return gather_blocks(p, axes, d)
 
         return jax.tree.map(one, params_local, param_specs,
                             is_leaf=lambda x: isinstance(x, P))
 
     def reduce_grads(grads_local):
         def one(g, spec):
-            d, axes = _find_shard_dim(spec, dp_axes)
+            d, axes = find_shard_dim(spec, dp_axes)
             rest = tuple(a for a in dp_axes if a not in axes
                          and lax.axis_size(a) > 1)
             if d is None:
@@ -228,7 +275,7 @@ def build_quantized_micro(engine) -> Any:
         return acc, loss
 
     scalar = P()
-    in_specs = (param_specs, grad_specs, scalar, scalar)
+    in_specs = (param_specs_manual, grad_specs_manual, scalar, scalar)
 
     def micro(params, acc_grads, scale, rng, *args):
         arg_specs = tuple(
@@ -236,7 +283,8 @@ def build_quantized_micro(engine) -> Any:
         f = jax.shard_map(
             micro_local, mesh=mesh,
             in_specs=in_specs + arg_specs,
-            out_specs=(grad_specs, P()),
+            out_specs=(grad_specs_manual, P()),
+            axis_names=frozenset(dp_axes),
             check_vma=False)
         return f(params, acc_grads, scale, rng, *args)
 
