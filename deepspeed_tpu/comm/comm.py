@@ -44,6 +44,45 @@ def is_initialized() -> bool:
     return _initialized
 
 
+def mpi_discovery(distributed_port: int = 29500, verbose: bool = True
+                  ) -> None:
+    """Populate RANK/WORLD_SIZE/LOCAL_RANK from scheduler environments when
+    the launcher didn't (reference comm/comm.py:673 ``mpi_discovery`` — it
+    broadcasts the master over MPI; here the SLURM / OpenMPI / Intel-MPI
+    environment variables carry everything, and the coordinator defaults to
+    the scheduler-provided first host)."""
+    env = os.environ
+    schemes = (
+        ("SLURM_PROCID", "SLURM_NTASKS", "SLURM_LOCALID"),
+        ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
+         "OMPI_COMM_WORLD_LOCAL_RANK"),
+        ("PMI_RANK", "PMI_SIZE", "MPI_LOCALRANKID"),
+    )
+    for rank_k, world_k, local_k in schemes:
+        if rank_k in env and world_k in env:
+            env.setdefault("RANK", env[rank_k])
+            env.setdefault("WORLD_SIZE", env[world_k])
+            if local_k in env:
+                env.setdefault("LOCAL_RANK", env[local_k])
+            if "COORDINATOR_ADDRESS" not in env:
+                # rank 0's HOST, not the submitting node:
+                # SLURM_LAUNCH_NODE_IPADDR is where srun was typed (often
+                # a login node with no task). The first entry of the job
+                # nodelist is rank 0 under block distribution; compressed
+                # ranges (node[01-04]) can't be parsed without scontrol,
+                # so leave it unset and let init fail loudly rather than
+                # hang on a coordinator nobody can bind.
+                nodelist = env.get("SLURM_JOB_NODELIST", "")
+                if nodelist and "[" not in nodelist:
+                    env["COORDINATOR_ADDRESS"] = \
+                        f"{nodelist.split(',')[0]}:{distributed_port}"
+            if verbose:
+                logger.info(
+                    f"mpi_discovery: rank={env['RANK']} "
+                    f"world={env['WORLD_SIZE']} (from {rank_k})")
+            return
+
+
 def init_distributed(dist_backend: str = "xla",
                      auto_mpi_discovery: bool = True,
                      distributed_port: int = 29500,
@@ -66,6 +105,8 @@ def init_distributed(dist_backend: str = "xla",
         return
     import jax
 
+    if auto_mpi_discovery and "RANK" not in os.environ:
+        mpi_discovery(verbose=verbose)
     coord = os.environ.get("COORDINATOR_ADDRESS") or init_method
     n_procs = int(os.environ.get("WORLD_SIZE", world_size if world_size > 0 else 1))
     if coord or n_procs > 1 or dist_init_required:

@@ -288,6 +288,12 @@ class InferenceEngineV2:
             seq = sm.get_sequence(uid)
             sm.maybe_allocate_kv(seq, steps)
             seqs.append(seq)
+        from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import (
+            RAGGED_DEBUG, validate_ragged_metadata)
+
+        if RAGGED_DEBUG:
+            validate_ragged_metadata(
+                seqs, [np.empty(steps)] * len(seqs), sm.block_size)
 
         from deepspeed_tpu.inference.v2.ragged.blocked_allocator import (
             BlockedAllocator)
@@ -355,6 +361,79 @@ class InferenceEngineV2:
     # ------------------------------------------------------------------ #
     def flush(self, uids: Sequence[int]) -> None:
         self.state_manager.flush(uids)
+
+    # ------------------------------------------------------------------ #
+    # serialize (reference engine_v2.py:237 + flat_model_helpers.py —
+    # flattened inference checkpoints: one contiguous payload + a metadata
+    # manifest, so a serving replica restores with a single sequential
+    # read instead of thousands of per-tensor files)
+    # ------------------------------------------------------------------ #
+    def serialize(self, save_path: str) -> None:
+        """Write ``model.bin`` (concatenated little-endian tensor payloads)
+        and ``metadata.json`` (name/shape/dtype/offset per tensor + engine
+        config) under ``save_path``."""
+        import json
+        import os
+
+        os.makedirs(save_path, exist_ok=True)
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            jax.device_get(self.params))
+        manifest = []
+        offset = 0
+        with open(os.path.join(save_path, "model.bin"), "wb") as f:
+            for path, leaf in flat:
+                arr = np.ascontiguousarray(np.asarray(leaf))
+                name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                                for k in path)
+                manifest.append({"name": name, "shape": list(arr.shape),
+                                 "dtype": arr.dtype.name, "offset": offset,
+                                 "nbytes": int(arr.nbytes)})
+                f.write(arr.tobytes())
+                offset += arr.nbytes
+        meta = {
+            "format_version": 1,
+            "tensors": manifest,
+            "engine_config": self.config.to_dict()
+            if hasattr(self.config, "to_dict") else {},
+        }
+        with open(os.path.join(save_path, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=1, default=str)
+        log_dist(f"InferenceEngineV2: serialized {len(manifest)} tensors "
+                 f"({offset/1e6:.1f} MB) to {save_path}", ranks=[0])
+
+    @staticmethod
+    def deserialize_params(save_path: str):
+        """Restore the flat param dict ``{name: np.ndarray}`` from
+        :meth:`serialize` output (memory-mapped, zero-copy views)."""
+        import json
+        import os
+
+        with open(os.path.join(save_path, "metadata.json")) as f:
+            meta = json.load(f)
+        data = np.memmap(os.path.join(save_path, "model.bin"), mode="r",
+                         dtype=np.uint8)
+        out = {}
+        for t in meta["tensors"]:
+            n = int(np.prod(t["shape"])) if t["shape"] else 1
+            arr = np.frombuffer(data, dtype=np.dtype(t["dtype"]), count=n,
+                                offset=t["offset"]).reshape(t["shape"])
+            out[t["name"]] = arr
+        return out
+
+    @classmethod
+    def load_serialized(cls, save_path: str, model,
+                        config: Optional[RaggedInferenceEngineConfig] = None):
+        """Build an engine from a serialized checkpoint: the flat names are
+        re-nested into the model's param-tree layout."""
+        flat = cls.deserialize_params(save_path)
+        tree: Dict[str, Any] = {}
+        for name, arr in flat.items():
+            node = tree
+            parts = name.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = np.asarray(arr)
+        return cls(model, tree, config)
 
     # ------------------------------------------------------------------ #
     # Convenience generation loop (the role MII plays above the reference

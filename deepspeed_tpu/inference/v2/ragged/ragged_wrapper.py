@@ -18,6 +18,7 @@ budget) packing tokens from up to ``max_seqs`` sequences::
 
 from __future__ import annotations
 
+import os
 from typing import List
 
 import numpy as np
@@ -28,6 +29,55 @@ from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import (
 )
 
 TRASH = BlockedAllocator.TRASH_BLOCK
+
+#: The paged kernel masks table slots past a sequence's length BY POSITION
+#: only — corrupted sequence metadata would silently read another
+#: sequence's KV. These host-side invariant checks are cheap (O(T + S*B))
+#: and on by default; set DEEPSPEED_TPU_RAGGED_DEBUG=0 to skip them on a
+#: hot serving path.
+RAGGED_DEBUG = os.environ.get("DEEPSPEED_TPU_RAGGED_DEBUG", "1") != "0"
+
+
+class RaggedMetadataError(RuntimeError):
+    """A ragged batch's sequence metadata violates the paged-KV invariants."""
+
+
+def validate_ragged_metadata(seqs: List[DSSequenceDescriptor],
+                             chunks: List[np.ndarray],
+                             block_size: int) -> None:
+    """Assert the invariants the paged kernel relies on (debug mode):
+
+    1. no two sequences own the same KV block (cross-sequence reads);
+    2. every sequence's block table covers seen_tokens + chunk (a write
+       past capacity would land in another sequence's block);
+    3. no sequence owns the trash block (pad writes target it).
+    """
+    owned = {}
+    for seq, chunk in zip(seqs, chunks):
+        if seq.seen_tokens < 0:
+            raise RaggedMetadataError(
+                f"sequence {seq.uid}: negative seen_tokens "
+                f"{seq.seen_tokens}")
+        need = seq.seen_tokens + len(chunk)
+        if len(seq.blocks) * block_size < need:
+            raise RaggedMetadataError(
+                f"sequence {seq.uid}: block table covers "
+                f"{len(seq.blocks) * block_size} positions but "
+                f"{need} are live — a KV write would spill into another "
+                f"sequence's block")
+        for b in seq.blocks:
+            if b == TRASH:
+                raise RaggedMetadataError(
+                    f"sequence {seq.uid} owns the trash block {TRASH}")
+            if b in owned:
+                raise RaggedMetadataError(
+                    f"KV block {b} owned by both sequence {owned[b]} and "
+                    f"sequence {seq.uid} — attention would read aliased "
+                    f"KV" if owned[b] != seq.uid else
+                    f"KV block {b} listed twice in sequence {seq.uid}'s "
+                    f"table — later positions would overwrite earlier "
+                    f"tokens' KV")
+            owned[b] = seq.uid
 
 
 class RaggedBatchWrapper:
@@ -78,6 +128,9 @@ class RaggedBatchWrapper:
             raise ValueError(
                 f"finalize: {self._tokens_used} scheduled tokens exceed "
                 f"token capacity {T}")
+        if RAGGED_DEBUG:
+            validate_ragged_metadata(self._seqs, self._chunks,
+                                     self.block_size)
         S, B = self.max_seqs, self.max_blocks
         bs = self.block_size
         token_ids = np.zeros((T,), np.int32)

@@ -1,0 +1,17 @@
+"""Launcher constants (reference: launcher/constants.py)."""
+
+DLTS_HOSTFILE = "/job/hostfile"
+
+#: environment variables forwarded to every launched worker
+EXPORT_ENVS = ("PYTHONPATH", "XLA_FLAGS", "JAX_PLATFORMS",
+               "TPU_CHIPS_PER_HOST", "DS_ACCELERATOR",
+               "DS_ELASTIC_NODE_RANGE")
+
+PDSH_LAUNCHER = "pdsh"
+SSH_LAUNCHER = "ssh"
+LOCAL_LAUNCHER = "local"
+OPENMPI_LAUNCHER = "openmpi"
+MPICH_LAUNCHER = "mpich"
+IMPI_LAUNCHER = "impi"
+MVAPICH_LAUNCHER = "mvapich"
+SLURM_LAUNCHER = "slurm"

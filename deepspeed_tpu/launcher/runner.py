@@ -30,9 +30,8 @@ from typing import Dict, List, Optional
 
 from deepspeed_tpu.utils.logging import logger
 
-DLTS_HOSTFILE = "/job/hostfile"
-EXPORT_ENVS = ("PYTHONPATH", "XLA_FLAGS", "JAX_PLATFORMS", "TPU_CHIPS_PER_HOST",
-               "DS_ACCELERATOR", "DS_ELASTIC_NODE_RANGE")
+from deepspeed_tpu.launcher.constants import (DLTS_HOSTFILE,  # noqa: F401
+                                              EXPORT_ENVS)
 
 
 def parse_args(args=None):
@@ -56,7 +55,8 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str,
                         default=os.environ.get("DS_MASTER_ADDR", ""))
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=("ssh", "pdsh", "local"))
+                        choices=("ssh", "pdsh", "local", "openmpi", "mpich",
+                                 "impi", "mvapich", "slurm"))
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--module", action="store_true",
                         help="run user_script as 'python -m <module>'")
@@ -163,17 +163,6 @@ def decode_world_info(encoded: str) -> Dict[str, List[int]]:
 # ------------------------------------------------------------------ #
 # Command construction
 # ------------------------------------------------------------------ #
-def _user_cmd(args) -> List[str]:
-    cmd: List[str] = []
-    if not args.no_python:
-        cmd += [sys.executable, "-u"]
-        if args.module:
-            cmd += ["-m"]
-    cmd.append(args.user_script)
-    cmd += args.user_args
-    return cmd
-
-
 def build_launch_cmd(args, world_info: Dict[str, List[int]],
                      node_rank: int, master_addr: str) -> List[str]:
     """The per-host ``launch`` invocation."""
@@ -191,7 +180,20 @@ def build_launch_cmd(args, world_info: Dict[str, List[int]],
 
 def build_multinode_cmds(args, world_info: Dict[str, List[int]],
                          master_addr: str) -> List[List[str]]:
-    """One remote command per host (ssh) or a single pdsh fan-out."""
+    """One remote command per host (ssh), a single pdsh fan-out, or a
+    single scheduler command (openmpi/mpich/impi/mvapich/slurm — reference
+    launcher/multinode_runner.py:117-374; rank comes from the scheduler's
+    environment via comm.mpi_discovery)."""
+    from deepspeed_tpu.launcher.multinode_runner import RUNNERS
+
+    if args.launcher in RUNNERS:
+        runner = RUNNERS[args.launcher](args, world_info, master_addr,
+                                        args.master_port)
+        if not runner.backend_exists():
+            raise RuntimeError(
+                f"--launcher={args.launcher}: required binary not found "
+                f"on PATH")
+        return [runner.get_cmd()]
     env_exports = " ".join(
         f"{k}={shlex.quote(os.environ[k])}" for k in EXPORT_ENVS
         if k in os.environ)
@@ -266,7 +268,9 @@ def main(args=None) -> int:
     def launch_once() -> int:
         world_info = _resolve_world(args)
         master_addr = args.master_addr or next(iter(world_info))
-        multi = (len(world_info) > 1 or args.force_multi) and \
+        scheduler = args.launcher in ("openmpi", "mpich", "impi",
+                                      "mvapich", "slurm")
+        multi = (len(world_info) > 1 or args.force_multi or scheduler) and \
             args.launcher != "local"
         if not multi:
             cmd = build_launch_cmd(args, world_info, 0, master_addr or

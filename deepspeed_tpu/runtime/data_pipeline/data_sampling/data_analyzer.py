@@ -66,8 +66,13 @@ class DataAnalyzer:
         """Compute this worker's shard of every metric."""
         lo, hi = self._shard_range()
         for name, fn in zip(self.metric_names, self.metric_functions):
-            vals = np.asarray([fn(self.dataset[i]) for i in range(lo, hi)],
-                              np.int64)
+            raw = [fn(self.dataset[i]) for i in range(lo, hi)]
+            vals = np.asarray(raw)
+            # keep float metrics float (perplexity-style difficulties);
+            # integral metrics normalise to int64
+            vals = vals.astype(np.int64 if np.issubdtype(vals.dtype,
+                                                         np.integer)
+                               else np.float64)
             os.makedirs(os.path.join(self.save_path, name), exist_ok=True)
             np.save(self._part_file(name, self.worker_id), vals)
         log_dist(f"DataAnalyzer map: worker {self.worker_id} analyzed "

@@ -511,3 +511,89 @@ def test_decode_loop_chunking_matches_put_loop():
     toks = eng2.decode_loop([1], [t0], steps=7)
     eng2.flush([1])
     np.testing.assert_array_equal([t0] + toks[0].tolist(), want)
+
+
+# ------------------------------------------------------------------ #
+# Debug-mode ragged invariants: corrupt metadata must raise, not return
+# wrong logits (the paged kernel masks by position only)
+# ------------------------------------------------------------------ #
+def test_ragged_debug_catches_shared_block():
+    from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import (
+        RaggedMetadataError)
+
+    params = _params()
+    eng = _v2_engine(params, block_size=8)
+    eng.put([1, 2], [[1, 2, 3], [4, 5]])
+    s1 = eng.state_manager.get_sequence(1)
+    s2 = eng.state_manager.get_sequence(2)
+    s2.blocks[0] = s1.blocks[0]  # corrupt: share a KV block
+    with pytest.raises(RaggedMetadataError, match="owned by both"):
+        eng.put([1, 2], [[7], [8]])
+
+
+def test_ragged_debug_catches_capacity_overrun():
+    from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import (
+        RaggedMetadataError, validate_ragged_metadata)
+
+    # 7 seen + 2 new = 9 positions, one 8-wide block: the write for
+    # position 8 would land in another sequence's block
+    seq = DSSequenceDescriptor(uid=1, seen_tokens=7, blocks=[3])
+    with pytest.raises(RaggedMetadataError, match="spill"):
+        validate_ragged_metadata([seq], [np.zeros(2, np.int32)], 8)
+    seq.seen_tokens = -1
+    with pytest.raises(RaggedMetadataError, match="negative"):
+        validate_ragged_metadata([seq], [np.zeros(1, np.int32)], 8)
+
+
+def test_ragged_debug_catches_trash_ownership():
+    from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import (
+        RaggedMetadataError)
+
+    params = _params()
+    eng = _v2_engine(params, block_size=8)
+    eng.put([1], [[1, 2, 3]])
+    seq = eng.state_manager.get_sequence(1)
+    seq.blocks[0] = 0  # corrupt: the trash block
+    with pytest.raises(RaggedMetadataError, match="trash"):
+        eng.put([1], [[7]])
+
+
+def test_ragged_debug_guards_decode_loop():
+    from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import (
+        RaggedMetadataError)
+
+    params = _params()
+    eng = _v2_engine(params, block_size=8)
+    logits = eng.put([1, 2], [[1, 2, 3], [4, 5]])
+    s1 = eng.state_manager.get_sequence(1)
+    s2 = eng.state_manager.get_sequence(2)
+    s2.blocks[0] = s1.blocks[0]
+    with pytest.raises(RaggedMetadataError, match="owned by both"):
+        eng.decode_loop([1, 2],
+                        [int(np.argmax(logits[1])),
+                         int(np.argmax(logits[2]))], steps=2)
+
+
+# ------------------------------------------------------------------ #
+# serialize (reference engine_v2.py:237 + flat_model_helpers.py)
+# ------------------------------------------------------------------ #
+def test_v2_serialize_roundtrip(tmp_path):
+    params = _params()
+    eng = _v2_engine(params)
+    rng = np.random.default_rng(13)
+    prompt = rng.integers(0, CFG.vocab_size, size=(6,)).tolist()
+    want = eng.generate([prompt], max_new_tokens=5)[0]
+
+    eng.serialize(str(tmp_path / "ckpt"))
+    assert (tmp_path / "ckpt" / "model.bin").exists()
+    assert (tmp_path / "ckpt" / "metadata.json").exists()
+
+    eng2 = InferenceEngineV2.load_serialized(
+        str(tmp_path / "ckpt"), RaggedLlama(CFG, 8),
+        RaggedInferenceEngineConfig.from_dict({
+            "state_manager": {"max_ragged_batch_size": 16,
+                              "max_ragged_sequence_count": 4,
+                              "max_context": 64},
+            "kv_cache": {"block_size": 8}}))
+    got = eng2.generate([prompt], max_new_tokens=5)[0]
+    np.testing.assert_array_equal(got, want)

@@ -237,3 +237,84 @@ def test_elastic_restart_gives_up(tmp_path):
         "--hostfile", "/nonexistent", "--num_gpus", "1",
         "--elastic_training", "--max_restarts", "1", str(script)])
     assert rc == 5
+
+
+# ------------------------------------------------------------------ #
+# scheduler-managed multinode runners (reference
+# launcher/multinode_runner.py:117-374)
+# ------------------------------------------------------------------ #
+def _runner_args(tmp_path, launcher):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-0 slots=4\nworker-1 slots=4\n")
+    from deepspeed_tpu.launcher.runner import parse_args
+
+    return parse_args([f"--hostfile={hostfile}", f"--launcher={launcher}",
+                       "train.py", "--lr", "0.1"])
+
+
+def test_openmpi_runner_cmd(tmp_path):
+    from deepspeed_tpu.launcher.multinode_runner import OpenMPIRunner
+
+    args = _runner_args(tmp_path, "openmpi")
+    r = OpenMPIRunner(args, {"worker-0": [0, 1, 2, 3],
+                             "worker-1": [0, 1, 2, 3]}, "worker-0", 29500)
+    cmd = r.get_cmd()
+    assert cmd[:3] == ["mpirun", "-n", "8"]
+    assert "-hostfile" in cmd
+    assert any("COORDINATOR_ADDRESS=worker-0:29500" in c for c in cmd)
+    assert cmd[-5:] == [sys.executable, "-u", "train.py", "--lr", "0.1"]
+
+
+def test_slurm_runner_cmd(tmp_path):
+    from deepspeed_tpu.launcher.multinode_runner import SlurmRunner
+
+    args = _runner_args(tmp_path, "slurm")
+    r = SlurmRunner(args, {"worker-0": [0, 1, 2, 3],
+                           "worker-1": [0, 1, 2, 3]}, "worker-0", 29500)
+    cmd = r.get_cmd()
+    assert cmd[0] == "srun" and cmd[1:3] == ["-n", "8"]
+    assert "--nodelist" in cmd
+    assert "--ntasks-per-node" in cmd
+    assert "train.py" in cmd
+
+
+def test_mpich_family_runner_cmds(tmp_path):
+    from deepspeed_tpu.launcher.multinode_runner import (IMPIRunner,
+                                                         MPICHRunner,
+                                                         MVAPICHRunner)
+
+    args = _runner_args(tmp_path, "mpich")
+    pool = {"worker-0": [0, 1], "worker-1": [0, 1]}
+    for cls in (MPICHRunner, IMPIRunner, MVAPICHRunner):
+        cmd = cls(args, pool, "worker-0", 29500).get_cmd()
+        assert cmd[:3] == ["mpirun", "-np", "4"]
+        assert "-ppn" in cmd and "train.py" in cmd
+    assert "MV2_ENABLE_AFFINITY" in MVAPICHRunner(
+        args, pool, "worker-0", 29500).get_cmd()
+
+
+def test_mpi_discovery_from_slurm_env(monkeypatch):
+    from deepspeed_tpu.comm.comm import mpi_discovery
+
+    for k in ("RANK", "WORLD_SIZE", "LOCAL_RANK", "COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    monkeypatch.setenv("SLURM_LOCALID", "1")
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "node-a,node-b")
+    mpi_discovery(distributed_port=12345, verbose=False)
+    import os
+
+    assert os.environ["RANK"] == "3"
+    assert os.environ["WORLD_SIZE"] == "8"
+    assert os.environ["LOCAL_RANK"] == "1"
+    # rank 0's host = first nodelist entry (block distribution)
+    assert os.environ["COORDINATOR_ADDRESS"] == "node-a:12345"
+
+    # compressed ranges can't be parsed without scontrol -> left unset
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "node[01-04]")
+    monkeypatch.delenv("RANK", raising=False)
+    monkeypatch.delenv("WORLD_SIZE", raising=False)
+    mpi_discovery(distributed_port=12345, verbose=False)
+    assert "COORDINATOR_ADDRESS" not in os.environ

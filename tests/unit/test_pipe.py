@@ -252,3 +252,49 @@ def test_inference_schedule_ticks():
     fwd = [c.buffer_id for cmds in sched.steps() for c in cmds
            if isinstance(c, ForwardPass)]
     assert fwd == list(range(6))
+
+
+def test_pipeline_remat_bounds_activation_memory():
+    """Peak activation (temp) memory at M >> S: remat keeps the per-tick
+    residual to ONE activation per microbatch, so (a) remat strictly
+    reduces peak temp memory at the same M, and (b) growing M 2->8 grows
+    remat'd temp memory far slower than the un-remat'd per-layer residuals
+    would (the 1F1B working-set goal, reached by remat instead of schedule
+    interleaving — pipe/engine.py module docstring)."""
+    import jax.numpy as jnp
+
+    S, d_in, mb = 2, 8, 4
+
+    def temp_bytes(m, remat):
+        groups.reset()
+        topo = groups.initialize_mesh(pipe_parallel_size=S,
+                                      data_parallel_size=4)
+        cfg = dict(CFG)
+        cfg["gradient_accumulation_steps"] = m
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=make_module(n_blocks=6, remat=remat), config=cfg,
+            topology=topo)
+        batches = make_batches(m, mb, d_in)
+        stacked = engine._collect_batch(None, batches)
+        stacked = engine.shard_batch(stacked)
+        engine.initialize_parameters(*stacked)
+
+        def loss_and_grads(params, *args):
+            return jax.value_and_grad(
+                lambda p: engine._pipe_apply(p, *args))(params)
+
+        shapes = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding),
+            (engine.state["params"],) + tuple(stacked))
+        compiled = jax.jit(loss_and_grads).lower(*shapes).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    t2_remat = temp_bytes(2, remat=1)
+    t8_remat = temp_bytes(8, remat=1)
+    t8_plain = temp_bytes(8, remat=0)
+    # (a) remat reduces peak temp memory at M=8
+    assert t8_remat < t8_plain, (t8_remat, t8_plain)
+    # (b) 4x the microbatches costs well under 4x the temp memory: the
+    # growth is one activation per extra tick, not a per-layer residual set
+    assert t8_remat < 4 * t2_remat, (t2_remat, t8_remat)
