@@ -106,7 +106,7 @@ def init_distributed(dist_backend: str = "xla",
     import jax
 
     if auto_mpi_discovery and "RANK" not in os.environ:
-        mpi_discovery(verbose=verbose)
+        mpi_discovery(distributed_port=distributed_port, verbose=verbose)
     coord = os.environ.get("COORDINATOR_ADDRESS") or init_method
     n_procs = int(os.environ.get("WORLD_SIZE", world_size if world_size > 0 else 1))
     if coord or n_procs > 1 or dist_init_required:

@@ -16,6 +16,7 @@ start one process per slot on every host. Two families:
 
 from __future__ import annotations
 
+import atexit
 import os
 import shlex
 import shutil
@@ -77,6 +78,7 @@ class MultiNodeRunner:
         for host, slots in self.world_info.items():
             f.write(f"{host} slots={len(slots)}\n")
         f.close()
+        atexit.register(lambda p=f.name: os.path.exists(p) and os.unlink(p))
         return f.name
 
     def _slots_per_host(self) -> int:
@@ -158,17 +160,19 @@ class SlurmRunner(MultiNodeRunner):
         return self._require("srun")
 
     def get_cmd(self) -> List[str]:
-        cmd = ["srun", "-n", str(self.world_size),
-               "--ntasks-per-node", str(self._slots_per_host())]
-        if self.world_info:
-            cmd += ["--nodelist", ",".join(self.world_info)]
-        # srun honours only the LAST --export option: fold everything
-        # into one flag
+        # env vars ride through --export=ALL from srun's OWN environment —
+        # an explicit --export K=V list would need comma escaping srun
+        # doesn't support (JAX_PLATFORMS=tpu,cpu would be split), so the
+        # extras are set on the srun process via an `env` prefix instead
         kv = {**_exports(),
               "COORDINATOR_ADDRESS":
               f"{self.master_addr}:{self.master_port}"}
-        cmd += ["--export=ALL," +
-                ",".join(f"{k}={v}" for k, v in kv.items())]
+        cmd = ["env"] + [f"{k}={v}" for k, v in kv.items()] + \
+            ["srun", "-n", str(self.world_size),
+             "--ntasks-per-node", str(self._slots_per_host()),
+             "--export=ALL"]
+        if self.world_info:
+            cmd += ["--nodelist", ",".join(self.world_info)]
         return cmd + self.launcher_args + _user_cmd(self.args)
 
 

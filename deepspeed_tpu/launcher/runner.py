@@ -268,8 +268,9 @@ def main(args=None) -> int:
     def launch_once() -> int:
         world_info = _resolve_world(args)
         master_addr = args.master_addr or next(iter(world_info))
-        scheduler = args.launcher in ("openmpi", "mpich", "impi",
-                                      "mvapich", "slurm")
+        from deepspeed_tpu.launcher.multinode_runner import RUNNERS
+
+        scheduler = args.launcher in RUNNERS
         multi = (len(world_info) > 1 or args.force_multi or scheduler) and \
             args.launcher != "local"
         if not multi:

@@ -295,21 +295,61 @@ def sparse_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 class SparseSelfAttention:
-    """Module-style wrapper (reference sparse_self_attention.py:28)."""
+    """Module-style wrapper (reference sparse_self_attention.py:28).
+
+    ``implementation``: 'pallas' = the block-SKIPPING kernel
+    (:mod:`ops.block_sparse_attention`, the Triton sdd/softmax/dsd
+    analog — empty tiles do no work); 'xla' = the dense-masked
+    composition (correctness reference; O(S²)); 'auto' = pallas on TPU
+    when no key-padding mask is given.
+    """
 
     def __init__(self, sparsity_config: SparsityConfig,
                  key_padding_mask_mode: str = "mul",
-                 attn_mask_mode: str = "mul"):
+                 attn_mask_mode: str = "mul",
+                 implementation: str = "auto"):
         if key_padding_mask_mode not in ("mul", "add"):
             raise ValueError(
                 f"unknown key_padding_mask_mode {key_padding_mask_mode!r}")
+        if implementation not in ("auto", "pallas", "xla"):
+            raise ValueError(f"unknown implementation {implementation!r}")
         self.config = sparsity_config
         self.key_padding_mask_mode = key_padding_mask_mode
         self.attn_mask_mode = attn_mask_mode
-        self._layouts = {}   # seq_len -> (layout, expanded device mask)
+        self.implementation = implementation
+        self._layouts = {}     # seq_len -> (layout, expanded device mask)
+        self._bs_layouts = {}  # seq_len -> BlockSparseLayout
+
+    def _use_kernel(self, key_padding_mask) -> bool:
+        if key_padding_mask is not None:
+            if self.implementation == "pallas":
+                raise ValueError(
+                    "implementation='pallas' does not support "
+                    "key_padding_mask yet — bake padding into the layout "
+                    "or use implementation='xla'")
+            return False
+        if self.implementation == "xla":
+            return False
+        if self.implementation == "pallas":
+            return True
+        from deepspeed_tpu.ops.block_sparse_attention import _on_tpu
+
+        return _on_tpu()
 
     def __call__(self, query, key, value, key_padding_mask=None):
         s = query.shape[2]
+        if self._use_kernel(key_padding_mask):
+            if s not in self._bs_layouts:
+                from deepspeed_tpu.ops.block_sparse_attention import (
+                    BlockSparseLayout)
+
+                self._bs_layouts[s] = BlockSparseLayout(
+                    self.config.make_layout(s), self.config.block, s)
+            from deepspeed_tpu.ops.block_sparse_attention import (
+                block_sparse_attention)
+
+            return block_sparse_attention(query, key, value,
+                                          self._bs_layouts[s])
         if s not in self._layouts:
             layout = self.config.make_layout(s)
             self._layouts[s] = (layout,

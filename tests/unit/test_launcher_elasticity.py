@@ -272,7 +272,14 @@ def test_slurm_runner_cmd(tmp_path):
     r = SlurmRunner(args, {"worker-0": [0, 1, 2, 3],
                            "worker-1": [0, 1, 2, 3]}, "worker-0", 29500)
     cmd = r.get_cmd()
-    assert cmd[0] == "srun" and cmd[1:3] == ["-n", "8"]
+    # env-prefixed srun: extras ride --export=ALL via the srun process
+    # environment (srun can't escape commas in an --export K=V list)
+    assert cmd[0] == "env"
+    i = cmd.index("srun")
+    assert any(c.startswith("COORDINATOR_ADDRESS=worker-0:29500")
+               for c in cmd[1:i])
+    assert cmd[i + 1:i + 3] == ["-n", "8"]
+    assert "--export=ALL" in cmd
     assert "--nodelist" in cmd
     assert "--ntasks-per-node" in cmd
     assert "train.py" in cmd
