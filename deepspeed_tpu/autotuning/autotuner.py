@@ -34,6 +34,44 @@ DEFAULT_MICRO_BATCHES = (1, 2, 4, 8)
 DEFAULT_STAGES = (0, 1, 2, 3)
 
 
+def _isolated_worker(payload_bytes: bytes, n_devices: int, platform: str,
+                     conn) -> None:
+    """Spawned-process entry for one isolated experiment (top-level so the
+    spawn context can import it; the heavy state rides in cloudpickle).
+    The backend env is pinned BEFORE unpickling — loading the payload
+    imports jax."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags and \
+            platform == "cpu":
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import cloudpickle
+
+    payload = cloudpickle.loads(payload_bytes)
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from deepspeed_tpu.parallel import groups
+
+    dims = payload["mesh_dims"]
+    groups.initialize_mesh(
+        pipe_parallel_size=dims["pipe"],
+        data_parallel_size=dims["dout"] * dims["data"],
+        sequence_parallel_size=dims["seq"],
+        expert_parallel_size=dims["expert"],
+        model_parallel_size=dims["model"],
+        zero_subgroup_size=dims["data"] if dims["dout"] > 1 else 0)
+    tuner = payload["tuner"]
+    exp = payload["exp"]
+    tuner._run_experiment(exp)
+    conn.send((exp.metric_val, exp.error))
+    conn.close()
+
+
 class Experiment:
     def __init__(self, name: str, config: Dict[str, Any]):
         self.name = name
@@ -60,10 +98,21 @@ class Autotuner:
                  hbm_bytes: Optional[float] = None,
                  activation_bytes_per_sample: Optional[float] = None,
                  peak_flops: float = 2e14, peak_bw: float = 8e11,
+                 isolate: bool = False, trial_timeout: float = 600.0,
                  seed: int = 0):
         """``sample_batch_fn(micro_batch)`` returns the engine-call args
         for one micro batch of that size (the model-info profile run uses
-        size 1)."""
+        size 1).
+
+        ``isolate=True`` SPAWNS each experiment into its own process
+        (reference autotuning/scheduler.py:430 runs experiments as
+        separate launches): a hard crash, native OOM abort, or hang
+        (``trial_timeout``) in one candidate prunes that candidate
+        instead of killing the whole tune. Intended for CPU-mesh tuning:
+        the tuning loop itself initialises the parent backend, so on a
+        single-chip TPU host the child cannot acquire the accelerator
+        the parent already holds.
+        """
         if tuner_type not in ("gridsearch", "random", "model_based"):
             raise ValueError(f"unknown tuner {tuner_type!r}")
         self.model = model
@@ -81,6 +130,8 @@ class Autotuner:
         self.activation_bytes_per_sample = activation_bytes_per_sample
         self.peak_flops = peak_flops  # roofline peaks for fast mode
         self.peak_bw = peak_bw
+        self.isolate = isolate
+        self.trial_timeout = trial_timeout
         self.rng = np.random.default_rng(seed)
         self.records: List[Experiment] = []
         self._num_params: Optional[int] = None
@@ -213,6 +264,53 @@ class Autotuner:
             logger.warning(f"autotuning experiment {exp.name} failed: "
                            f"{exp.error[:200]}")
 
+    def _run_experiment_isolated(self, exp: Experiment) -> None:
+        """Run one experiment in its OWN process so a hard crash / native
+        OOM abort / hang cannot take down the tuning loop. Spawn (not
+        fork): the parent's initialised XLA backend holds thread-pool
+        locks a forked child would deadlock on. The child re-creates the
+        parent's mesh; its platform is pinned to the parent's (a CPU-mesh
+        parent must not have the child grab a TPU via ambient env)."""
+        import multiprocessing as mp
+
+        import cloudpickle
+        import jax
+
+        from deepspeed_tpu.parallel import groups
+
+        ctx = mp.get_context("spawn")
+        recv, send = ctx.Pipe(duplex=False)
+        payload = cloudpickle.dumps({
+            "tuner": self,
+            "exp": exp,
+            "mesh_dims": groups.get_topology().dims.as_dict(),
+        })
+        p = ctx.Process(
+            target=_isolated_worker,
+            args=(payload, len(jax.devices()),
+                  jax.devices()[0].platform, send))
+        p.start()
+        send.close()
+        metric = err = None
+        if recv.poll(self.trial_timeout):
+            try:
+                metric, err = recv.recv()
+            except EOFError:  # child died before sending
+                pass
+        else:
+            err = f"trial timed out after {self.trial_timeout:.0f}s"
+        p.join(5)
+        if p.is_alive():
+            p.terminate()
+            p.join()
+        if metric is None and err is None:
+            err = f"experiment process died (exit code {p.exitcode})"
+        exp.metric_val = metric
+        exp.error = err
+        if err:
+            logger.warning(
+                f"autotuning experiment {exp.name} failed: {err[:200]}")
+
     # -------------------------------------------------------------- #
     def tune(self) -> Dict[str, Any]:
         """Run the search; returns the best full DS config (reference
@@ -234,7 +332,10 @@ class Autotuner:
                 continue
             exp = Experiment(name, self._exp_config(cand))
             groups.set_topology(topo)
-            self._run_experiment(exp)
+            if self.isolate:
+                self._run_experiment_isolated(exp)
+            else:
+                self._run_experiment(exp)
             self.records.append(exp)
             with open(os.path.join(self.results_dir, f"{name}.json"),
                       "w") as f:

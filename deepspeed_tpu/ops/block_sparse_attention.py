@@ -31,7 +31,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
+from deepspeed_tpu.ops.flash_attention import NEG_INF, _on_tpu
 
 
 def _pick_tile(s: int, block: int, target: int = 256) -> int:
@@ -343,13 +343,6 @@ def _bs_bwd(block, block_q, block_k, scale, interpret, res, g):
 
 
 _bs_attn.defvjp(_bs_fwd, _bs_bwd)
-
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:  # noqa: BLE001
-        return False
 
 
 class BlockSparseLayout:

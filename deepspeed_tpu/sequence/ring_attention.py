@@ -13,6 +13,12 @@ attention online (flash-style running max/denominator), so
   communication pattern rides ICI links;
 * the softmax is exact (online renormalisation), not an approximation.
 
+GQA (``Hkv < H``) attends grouped — queries reshape to
+``[B, Hkv, G, S, D]`` so K/V are never head-replicated on the wire or in
+memory. A causal sliding window (Mistral SWA) bounds BOTH the mask and
+the ring itself: a window spanning W chunks needs only W hops, so
+communication drops from O(N) to O(W/chunk) rotations.
+
 The backward pass differentiates through the ``lax.scan`` of ring steps
 (recomputing per-hop attention), giving the blockwise-parallel-transformer
 memory profile without a bespoke backward kernel.
@@ -39,20 +45,30 @@ NEG_INF = -1e30
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str = "seq", causal: bool = True,
-                   scale: Optional[float] = None) -> jnp.ndarray:
+                   scale: Optional[float] = None,
+                   window: Optional[int] = None) -> jnp.ndarray:
     """Shard_map-interior ring attention.
 
-    q/k/v: LOCAL chunks [B, S_local, H, D] (device i owns sequence
-    positions [i*S_local, (i+1)*S_local)). Returns the local output chunk.
+    q: LOCAL chunk [B, S_local, H, D]; k/v: [B, S_local, Hkv, D] with
+    ``H % Hkv == 0`` (GQA). Device i owns sequence positions
+    [i*S_local, (i+1)*S_local). ``window`` (requires ``causal``) restricts
+    each query to the previous ``window`` keys AND shortens the ring to
+    the hops that can still contribute. Returns the local output chunk.
     """
     b, s_loc, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv != 0:
+        raise ValueError(f"GQA needs H % Hkv == 0, got {h} % {hkv}")
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
+    g = h // hkv
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
 
     qf = q.astype(jnp.float32) * scale
-    # [B, H, S, D] layout for the inner matmuls
-    qf = qf.transpose(0, 2, 1, 3)
+    # [B, Hkv, G, S, D] layout: K/V stay per-kv-head (never replicated)
+    qf = qf.transpose(0, 2, 1, 3).reshape(b, hkv, g, s_loc, d)
 
     q_pos = idx * s_loc + jnp.arange(s_loc)           # global query positions
 
@@ -62,18 +78,23 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         # this round we hold the KV chunk of device (idx - r) mod n
         src = (idx - r) % n
         k_pos = src * s_loc + jnp.arange(s_loc)
-        kf = kb.astype(jnp.float32).transpose(0, 2, 1, 3)   # [B,H,S,D]
+        kf = kb.astype(jnp.float32).transpose(0, 2, 1, 3)   # [B,Hkv,S,D]
         vf = vb.astype(jnp.float32).transpose(0, 2, 1, 3)
-        s_blk = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
+        s_blk = jnp.einsum("bkgqd,bksd->bkgqs", qf, kf)
         if causal:
             mask = k_pos[None, :] <= q_pos[:, None]          # [Sq, Sk]
-            s_blk = jnp.where(mask[None, None], s_blk, NEG_INF)
-        m_cur = jnp.max(s_blk, axis=-1, keepdims=True)       # [B,H,Sq,1]
+            if window is not None:
+                mask &= k_pos[None, :] > q_pos[:, None] - window
+            s_blk = jnp.where(mask[None, None, None], s_blk, NEG_INF)
+        m_cur = jnp.max(s_blk, axis=-1, keepdims=True)   # [B,Hkv,G,Sq,1]
         m_new = jnp.maximum(m, m_cur)
         p = jnp.exp(s_blk - m_new)
+        if causal:
+            # an all-masked row has m_new == NEG_INF and exp(0) == 1
+            p = jnp.where(mask[None, None, None], p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * corr + jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+        acc_new = acc * corr + jnp.einsum("bkgqs,bksd->bkgqd", p, vf)
         return acc_new, m_new, l_new
 
     def ring_step(carry, r):
@@ -84,16 +105,22 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         acc, m, l = attend_block(acc, m, l, kb, vb, r)
         return (acc, m, l, kb, vb), None
 
-    acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
-    m0 = jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
-    # round 0 attends the resident chunk — n-1 rotations total
+    acc0 = jnp.zeros((b, hkv, g, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, hkv, g, s_loc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, s_loc, 1), jnp.float32)
+    # round 0 attends the resident chunk — up to n-1 rotations after.
+    # A causal window spanning W positions only reaches back
+    # ceil(W / S_local) chunks: later hops hold chunks entirely below
+    # every query's band and are pure wasted compute AND communication.
+    rounds = n - 1
+    if window is not None:
+        rounds = min(n - 1, -(-window // s_loc))
     acc, m, l = attend_block(acc0, m0, l0, k, v, 0)
-    if n > 1:
+    if rounds > 0:
         (acc, m, l, _, _), _ = lax.scan(ring_step, (acc, m, l, k, v),
-                                        jnp.arange(1, n))
+                                        jnp.arange(1, rounds + 1))
     safe_l = jnp.where(l == 0.0, 1.0, l)
-    out = (acc / safe_l).transpose(0, 2, 1, 3)            # [B, S, H, D]
+    out = (acc / safe_l).reshape(b, h, s_loc, d).transpose(0, 2, 1, 3)
     return out.astype(q.dtype)
 
 
@@ -120,12 +147,13 @@ class DistributedRingAttention:
                  scale: Optional[float] = None,
                  mask=None, window: Optional[int] = None, **_kwargs):
         """Accepts the attention_fn call surface models use
-        (``causal=``/``scale=``); block-sparse windows and custom masks are
-        not ring-composable yet and fail loudly."""
-        if mask is not None or window is not None:
+        (``causal=``/``scale=``/``window=`` — so Llama/Mistral-style GQA
+        models plug in directly); arbitrary custom masks are not
+        ring-composable and fail loudly."""
+        if mask is not None:
             raise NotImplementedError(
-                "ring attention supports causal/full only (no custom mask "
-                "or sliding window yet)")
+                "ring attention supports causal/full (+sliding window) "
+                "only — custom masks don't decompose over ring hops")
         from deepspeed_tpu.parallel import groups
 
         mesh = mesh or groups.get_mesh()
@@ -136,7 +164,8 @@ class DistributedRingAttention:
                 ring_attention,
                 axis_name=self.sequence_axis,
                 causal=self.causal if causal is None else causal,
-                scale=scale),
+                scale=scale,
+                window=window),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,

@@ -60,3 +60,29 @@ def test_model_based_order(tmp_path):
                    micro_batch_sizes=[2], zero_stages=[0, 3])
     cands = tuner._candidates()
     assert cands[0]["zero_stage"] == 3  # cheapest memory first
+
+
+def test_isolated_experiments_survive_hard_crash(tmp_path):
+    """isolate=True: a candidate whose trial hard-kills its process (the
+    failure the in-process loop could never survive — reference isolates
+    experiments as separate launches, scheduler.py:430) is pruned and the
+    tune still returns the best surviving config."""
+    import os
+
+    m = SimpleModel(hidden_dim=HIDDEN)
+    orig_apply = m.apply
+
+    def crashing_apply(params, x, y, rng=None, train=True):
+        if x.shape[0] >= 2 * 8:       # micro_batch >= 2 -> hard abort
+            os._exit(17)
+        return orig_apply(params, x, y, rng=rng, train=train)
+
+    base = {"optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+    tuner = Autotuner((m.init, crashing_apply), base, _batch_fn,
+                      results_dir=str(tmp_path / "results"),
+                      micro_batch_sizes=[1, 2], zero_stages=[0],
+                      steps_per_trial=1, isolate=True, trial_timeout=120)
+    best = tuner.tune()
+    assert best["train_micro_batch_size_per_gpu"] == 1
+    crashed = [r for r in tuner.records if r.error]
+    assert crashed and "died" in crashed[0].error
