@@ -97,7 +97,8 @@ def main():
                         num_attention_heads=6, num_key_value_heads=6,
                         max_position_embeddings=2048, dtype=jnp.bfloat16)
     seq = 1024
-    micro_batch = 8
+    micro_batch = 16  # amortises the per-step fixed costs; measured +4%
+    # tok/s over 8 on v5e with no accuracy-relevant change
 
     ds_config = {
         "train_micro_batch_size_per_gpu": micro_batch,

@@ -278,10 +278,14 @@ class Autotuner:
 
         from deepspeed_tpu.parallel import groups
 
+        import copy
+
         ctx = mp.get_context("spawn")
         recv, send = ctx.Pipe(duplex=False)
+        lean = copy.copy(self)        # don't ship the experiment history
+        lean.records = []
         payload = cloudpickle.dumps({
-            "tuner": self,
+            "tuner": lean,
             "exp": exp,
             "mesh_dims": groups.get_topology().dims.as_dict(),
         })
@@ -307,7 +311,9 @@ class Autotuner:
             err = f"experiment process died (exit code {p.exitcode})"
         exp.metric_val = metric
         exp.error = err
-        if err:
+        if err and metric is None and "died" in (err or "") or \
+                (err and "timed out" in err):
+            # soft failures already logged by the child's own handler
             logger.warning(
                 f"autotuning experiment {exp.name} failed: {err[:200]}")
 

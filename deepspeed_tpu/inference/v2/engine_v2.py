@@ -154,10 +154,11 @@ class InferenceEngineV2:
                 results[uid] = logits
         return results
 
-    def _get_step(self, bucket: int):
-        """One jitted (model fwd ∘ metadata unpack) program per token
-        bucket; the KV pool is donated."""
-        step = self._steps.get(bucket)
+    def _get_step(self, bucket: int, prefill_tile: Optional[int] = None):
+        """One jitted (model fwd ∘ metadata unpack) program per
+        (token bucket, tile mode); the KV pool is donated."""
+        key = (bucket, prefill_tile)
+        step = self._steps.get(key)
         if step is None:
             from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import (
                 unpack_metadata)
@@ -166,10 +167,11 @@ class InferenceEngineV2:
 
             def run(params, cache, packed):
                 batch = unpack_metadata(packed, bucket, S, B)
-                return self.model(params, cache, batch)
+                return self.model(params, cache, batch,
+                                  prefill_tile=prefill_tile)
 
             step = jax.jit(run, donate_argnums=(1,))
-            self._steps[bucket] = step
+            self._steps[key] = step
         return step
 
     def _has_pending(self, uids) -> bool:
@@ -177,19 +179,37 @@ class InferenceEngineV2:
                    and self.state_manager.get_sequence(u).pending
                    for u in uids)
 
+    #: q-tile for the tiled prefill kernel (the reference atom_builder's
+    #: work-unit height); chunks pack tile-aligned when every scheduled
+    #: chunk is at least this long, so the alignment padding never exceeds
+    #: 50% of the scheduled tokens
+    PREFILL_TILE = 128
+
     def _run_one_batch(self, uids) -> Dict[int, np.ndarray]:
         """Build one ragged batch under the token budget (SplitFuse
         chunking), run the jitted step, and return logits for slots whose
         pending queue drained."""
         sm = self.state_manager
         self._batch.clear()
+        # tiled-prefill mode: every live chunk long enough that aligning
+        # each to a PREFILL_TILE boundary wastes < half the budget
+        tile = self.PREFILL_TILE
+        pend = [len(sm.get_sequence(u).pending) for u in uids
+                if sm.get_sequence(u) is not None
+                and sm.get_sequence(u).pending]
+        use_tiles = (bool(pend) and min(pend) >= tile
+                     and self._batch.token_budget >= tile
+                     and self._batch.token_budget % tile == 0)
+        if use_tiles:
+            self._batch.set_alignment(tile)
         scheduled: List[int] = []
         drained: List[bool] = []
         for uid in uids:
             seq = sm.get_sequence(uid)
             if seq is None or not seq.pending:
                 continue
-            room = self._batch.token_budget - self._batch.current_tokens
+            # room from the (tile-aligned, in tiled mode) next chunk start
+            room = self._batch.token_budget - self._batch._next_start()
             if room <= 0 or self._batch.current_sequences >= \
                     self._batch.max_seqs:
                 break
@@ -204,11 +224,18 @@ class InferenceEngineV2:
         from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import (
             pack_metadata)
 
-        bucket = min(b for b in self._buckets
-                     if b >= self._batch.current_tokens)
+        if use_tiles:
+            # the tiled kernel needs a tile-divisible token capacity
+            cands = [b for b in self._buckets if b % tile == 0] + [tile]
+            bucket = min(b for b in cands
+                         if b >= self._batch.current_tokens)
+        else:
+            bucket = min(b for b in self._buckets
+                         if b >= self._batch.current_tokens)
         meta = self._batch.finalize(bucket)
         packed = jnp.asarray(pack_metadata(meta))  # ONE upload
-        logits, new_cache = self._get_step(bucket)(
+        logits, new_cache = self._get_step(
+            bucket, tile if use_tiles else None)(
             self.params, sm.kv_cache.cache, packed)
         sm.kv_cache.update(new_cache)
 

@@ -3,6 +3,8 @@
 from deepspeed_tpu.inference.v2.kernels.blocked_flash import (
     paged_attention,
     paged_attention_usable,
+    paged_prefill_attention,
 )
 
-__all__ = ["paged_attention", "paged_attention_usable"]
+__all__ = ["paged_attention", "paged_attention_usable",
+           "paged_prefill_attention"]

@@ -101,6 +101,149 @@ def paged_attention_usable(q, k_pool, block_size: int) -> bool:
     return (h % hkv == 0 and d % 8 == 0 and block_size % 8 == 0)
 
 
+# ===================================================================== #
+# Tiled prefill (reference ragged_ops/atom_builder + blocked_flash: work
+# units are "atoms" = a q-tile of consecutive same-sequence tokens x a KV
+# block range). The engine packs prefill chunks TILE-ALIGNED in the token
+# buffer, so every [tile_q]-row stripe belongs to one sequence (pad rows
+# carry position -1 and mask to zero) — the grid is (tiles, blocks), not
+# (tokens, blocks): a 512-token prefill at tile 128 runs 4xB steps
+# instead of 512xB.
+# ===================================================================== #
+def _prefill_kernel(tile_slot, tile_maxpos, tables, q_ref, pos_ref, k_ref,
+                    v_ref, o_ref, acc_ref, m_ref, l_ref, *, block_size,
+                    num_blocks_per_seq, scale, tile_q, num_heads,
+                    num_kv_heads, window):
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+    g = num_heads // num_kv_heads
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    maxpos = tile_maxpos[t]
+    run = jnp.logical_and(j * block_size <= maxpos, maxpos >= 0)
+    if window is not None:
+        # the whole tile is below the window band for this block -> skip
+        run = jnp.logical_and(
+            run, (j + 1) * block_size - 1 > maxpos - tile_q - window)
+
+    @pl.when(run)
+    def _():
+        pos = pos_ref[:, :1]                          # [tile_q, 1] (-1 pads)
+        key_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (tile_q, block_size), 1)
+        keep = key_pos <= pos
+        if window is not None:
+            keep = jnp.logical_and(keep, key_pos > pos - window)
+        for h in range(num_heads):
+            q = q_ref[:, h, :]                        # [tile_q, d]
+            kb = k_ref[0, :, h // g, :]               # [bs, d]
+            vb = v_ref[0, :, h // g, :]
+            s = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(keep, s, NEG_INF)
+            m_prev = m_ref[h, :, :1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new)
+            p = jnp.where(keep, p, 0.0)  # all-masked rows: exp(0) == 1
+            corr = jnp.exp(m_prev - m_new)
+            l_ref[h] = jnp.broadcast_to(
+                l_ref[h, :, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+                l_ref[h].shape)
+            acc_ref[h] = acc_ref[h] * corr + jax.lax.dot_general(
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref[h].shape)
+
+    @pl.when(j == num_blocks_per_seq - 1)
+    def _():
+        for h in range(num_heads):
+            l = l_ref[h, :, :1]
+            safe_l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[:, h, :] = (acc_ref[h] / safe_l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_size", "tile_q", "window",
+                                    "interpret"))
+def paged_prefill_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                            v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                            token_slot: jnp.ndarray,
+                            token_pos: jnp.ndarray,
+                            *, block_size: int, tile_q: int,
+                            window: Any = None,
+                            interpret: Any = None) -> jnp.ndarray:
+    """Tiled paged attention for TILE-ALIGNED token buffers.
+
+    q: [T, H, D] with every [tile_q] stripe single-sequence; token_pos
+    [T] int32 with -1 on pad rows. Returns [T, H, D] (pad rows 0).
+    """
+    t_count, h, d = q.shape
+    hkv = k_pool.shape[1]
+    nb = k_pool.shape[0] // block_size
+    s_count, b_per_seq = block_tables.shape
+    nt = t_count // tile_q
+    if interpret is None:
+        from deepspeed_tpu.ops.flash_attention import _on_tpu
+
+        interpret = not _on_tpu()
+
+    kp = k_pool.reshape(nb, block_size, hkv, d)
+    vp = v_pool.reshape(nb, block_size, hkv, d)
+    scale = 1.0 / (d ** 0.5)
+
+    # per-tile metadata (XLA-land, cheap): the stripe's slot + max position
+    tile_slot = token_slot.reshape(nt, tile_q)[:, 0].astype(jnp.int32)
+    tile_maxpos = token_pos.reshape(nt, tile_q).max(axis=1).astype(jnp.int32)
+    pos8 = jnp.broadcast_to(token_pos.astype(jnp.int32)[:, None],
+                            (t_count, 8))
+
+    def _kv_index(t, j, slot, maxpos, tab):
+        jj = jnp.minimum(j, jnp.maximum(maxpos[t], 0) // block_size)
+        if window is not None:
+            lo = jnp.maximum(
+                (maxpos[t] - tile_q - window + 1) // block_size, 0)
+            jj = jnp.maximum(jj, jnp.minimum(
+                lo, jnp.maximum(maxpos[t], 0) // block_size))
+        return (tab[slot[t], jj], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nt, b_per_seq),
+        in_specs=[
+            pl.BlockSpec((tile_q, h, d),
+                         lambda t, j, slot, maxpos, tab: (t, 0, 0)),
+            pl.BlockSpec((tile_q, 8),
+                         lambda t, j, slot, maxpos, tab: (t, 0)),
+            pl.BlockSpec((1, block_size, hkv, d), _kv_index),
+            pl.BlockSpec((1, block_size, hkv, d), _kv_index),
+        ],
+        out_specs=pl.BlockSpec((tile_q, h, d),
+                               lambda t, j, slot, maxpos, tab: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, tile_q, d), jnp.float32),
+            pltpu.VMEM((h, tile_q, 128), jnp.float32),
+            pltpu.VMEM((h, tile_q, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel, block_size=block_size,
+        num_blocks_per_seq=b_per_seq, scale=scale, tile_q=tile_q,
+        num_heads=h, num_kv_heads=hkv, window=window)
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t_count, h, d), q.dtype),
+        interpret=bool(interpret),
+    )(tile_slot, tile_maxpos, block_tables.astype(jnp.int32), q, pos8,
+      kp, vp)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("block_size", "window", "interpret"))
 def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,

@@ -85,18 +85,20 @@ def _rms_norm(x, scale, eps):
 
 
 def _paged_attention(q, k_pool, v_pool, batch, block_size,
-                     use_kernel=None, window=None):
+                     use_kernel=None, window=None, prefill_tile=None):
     """Paged attention over the blocked KV pool.
 
     q: [T, H, D]; k_pool/v_pool: [num_blocks*bs, Hkv, D].
     Returns [T, H, D]. Under TP the caller passes LOCAL heads — the kernel
     is oblivious to the mesh. ``window`` = Mistral sliding-window width.
 
-    On TPU this routes to the Pallas blocked-flash kernel
+    On TPU this routes to the Pallas blocked-flash kernels
     (inference/v2/kernels/blocked_flash.py): block tables drive the
     kernel's DMA schedule, so no [T, C, Hkv, D] context gather is ever
-    materialised. The XLA gather composition below is the reference/CPU
-    path.
+    materialised. ``prefill_tile`` (engine-set when the batch was packed
+    tile-aligned) selects the TILED kernel — grid (tiles, blocks) instead
+    of (tokens, blocks), the reference's atom_builder work-unit shape.
+    The XLA gather composition below is the reference/CPU path.
     """
     if use_kernel is None:
         try:
@@ -105,14 +107,21 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
             use_kernel = False
     if use_kernel:
         from deepspeed_tpu.inference.v2.kernels import (
-            paged_attention, paged_attention_usable)
+            paged_attention, paged_attention_usable,
+            paged_prefill_attention)
 
         if paged_attention_usable(q, k_pool, block_size):
+            w = int(window) if window is not None else None
+            if prefill_tile and q.shape[0] % prefill_tile == 0:
+                return paged_prefill_attention(
+                    q, k_pool, v_pool, batch["block_tables"],
+                    batch["token_slot"], batch["token_pos"],
+                    block_size=block_size, tile_q=int(prefill_tile),
+                    window=w)
             return paged_attention(
                 q, k_pool, v_pool, batch["block_tables"],
                 batch["token_slot"], batch["token_pos"],
-                block_size=block_size,
-                window=int(window) if window is not None else None)
+                block_size=block_size, window=w)
     block_tables = batch["block_tables"]          # [S, B]
     token_slot = batch["token_slot"]              # [T]
     token_pos = batch["token_pos"]                # [T]
@@ -144,14 +153,19 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
     mask = key_pos <= token_pos[:, None]          # [T, C]
     if window is not None:
         mask &= key_pos > token_pos[:, None] - window
-    scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
+    # FINITE mask value: with -inf an all-masked row (tile-aligned pads
+    # carry position -1) softmaxes to NaN, the NaN hidden state is written
+    # to the trash block, and 0 * NaN poisons REAL rows via the masked
+    # context lanes of the next layer's einsum
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("tkgc,tckd->tkgd", probs, v_t.astype(jnp.float32))
     return out.reshape(q.shape).astype(q.dtype)
 
 
 def ragged_attention_block(lp_attn, xa, layer_cache, batch, block_size, cfg,
-                           h, hkv, d, cos, sin, ax=None):
+                           h, hkv, d, cos, sin, ax=None,
+                           prefill_tile=None):
     """Shared per-layer attention body (RaggedLlama + RaggedMixtral):
     qkv proj → rotary → paged-KV scatter → blocked-flash → o_proj
     (+ row-parallel psum under TP). ``h``/``hkv`` are LOCAL head counts.
@@ -167,7 +181,8 @@ def ragged_attention_block(lp_attn, xa, layer_cache, batch, block_size, cfg,
     k_pool = layer_cache["k"].at[kv_dest].set(k.astype(layer_cache["k"].dtype))
     v_pool = layer_cache["v"].at[kv_dest].set(v.astype(layer_cache["v"].dtype))
     out = _paged_attention(q, k_pool, v_pool, batch, block_size,
-                           window=cfg.sliding_window)
+                           window=cfg.sliding_window,
+                           prefill_tile=prefill_tile)
     out = out.reshape(-1, h * d) @ lp_attn["o_proj"]["kernel"].astype(dt)
     if ax is not None:
         out = jax.lax.psum(out, ax)                   # row-parallel attn-out
@@ -221,20 +236,23 @@ class RaggedLlama:
         return self.config.head_dim
 
     def __call__(self, params: Dict[str, Any], kv_cache: Dict[str, Any],
-                 batch: Dict[str, jax.Array]):
+                 batch: Dict[str, jax.Array], prefill_tile=None):
         """Run one ragged forward.
 
         Returns ``(logits [S, vocab], new_kv_cache)`` where row ``s`` holds
-        the logits of slot ``s``'s LAST scheduled token.
+        the logits of slot ``s``'s LAST scheduled token. ``prefill_tile``
+        (static) marks a tile-aligned batch -> tiled prefill kernel.
         """
         if self.tp == 1:
-            return self._forward(params, kv_cache, batch, ax=None)
+            return self._forward(params, kv_cache, batch, ax=None,
+                                 prefill_tile=prefill_tile)
         from jax.experimental.shard_map import shard_map
 
         param_specs = ragged_param_specs(params)
         cache_specs = jax.tree.map(lambda _x: KV_SPEC, kv_cache)
         batch_specs = jax.tree.map(lambda _x: P(), batch)
-        fwd = functools.partial(self._forward, ax=self.tp_axis)
+        fwd = functools.partial(self._forward, ax=self.tp_axis,
+                                prefill_tile=prefill_tile)
         return shard_map(
             fwd, mesh=self.mesh,
             in_specs=(param_specs, cache_specs, batch_specs),
@@ -255,7 +273,7 @@ class RaggedLlama:
         x = jnp.where(ok[:, None], emb[jnp.clip(loc, 0, v_local - 1)], 0)
         return jax.lax.psum(x, ax)
 
-    def _forward(self, params, kv_cache, batch, *, ax):
+    def _forward(self, params, kv_cache, batch, *, ax, prefill_tile=None):
         cfg = self.config
         m = params["model"]
         dt = cfg.dtype

@@ -92,7 +92,18 @@ class RaggedBatchWrapper:
     def clear(self):
         self._seqs: List[DSSequenceDescriptor] = []
         self._chunks: List[np.ndarray] = []
+        self._starts: List[int] = []
         self._tokens_used = 0
+        self._align = 0
+
+    def set_alignment(self, align: int) -> None:
+        """Tile-align chunk starts (the prefill kernel's contract: every
+        [align]-row stripe of the token buffer is single-sequence; pad
+        rows carry position -1). Call right after clear(); alignment
+        padding counts against the token budget."""
+        if self._seqs:
+            raise RuntimeError("set_alignment before inserting sequences")
+        self._align = int(align)
 
     @property
     def current_tokens(self) -> int:
@@ -102,8 +113,14 @@ class RaggedBatchWrapper:
     def current_sequences(self) -> int:
         return len(self._seqs)
 
+    def _next_start(self) -> int:
+        if self._align <= 1:
+            return self._tokens_used
+        a = self._align
+        return ((self._tokens_used + a - 1) // a) * a
+
     def can_fit(self, n_tokens: int) -> bool:
-        return (self._tokens_used + n_tokens <= self.token_budget
+        return (self._next_start() + n_tokens <= self.token_budget
                 and len(self._seqs) < self.max_seqs)
 
     def insert_sequence(self, seq: DSSequenceDescriptor,
@@ -111,9 +128,11 @@ class RaggedBatchWrapper:
         """reference ``insert_sequence``: add one sequence's chunk."""
         if not self.can_fit(len(tokens)):
             raise RuntimeError("ragged batch full")
+        start = self._next_start()
         self._seqs.append(seq)
         self._chunks.append(np.asarray(tokens, np.int32))
-        self._tokens_used += len(tokens)
+        self._starts.append(start)
+        self._tokens_used = start + len(tokens)
 
     def finalize(self, token_capacity: int = None):
         """Build the device metadata (reference ``finalize``: host->device
@@ -135,15 +154,17 @@ class RaggedBatchWrapper:
         bs = self.block_size
         token_ids = np.zeros((T,), np.int32)
         token_slot = np.zeros((T,), np.int32)
-        token_pos = np.zeros((T,), np.int32)
+        # aligned mode: pads carry position -1 so both kernels and the XLA
+        # path mask them to zero rows
+        token_pos = np.full((T,), -1 if self._align > 1 else 0, np.int32)
         kv_dest = np.full((T,), TRASH * bs, np.int32)  # pads -> trash block
         block_tables = np.full((S, B), TRASH, np.int32)
         context_lens = np.zeros((S,), np.int32)
         logits_idx = np.zeros((S,), np.int32)
         n_valid = len(self._seqs)
 
-        cursor = 0
-        for slot, (seq, chunk) in enumerate(zip(self._seqs, self._chunks)):
+        for slot, (seq, chunk, cursor) in enumerate(
+                zip(self._seqs, self._chunks, self._starts)):
             n = len(chunk)
             pos = np.arange(seq.seen_tokens, seq.seen_tokens + n, dtype=np.int32)
             token_ids[cursor:cursor + n] = chunk
@@ -157,7 +178,6 @@ class RaggedBatchWrapper:
             kv_dest[cursor:cursor + n] = blocks[pos // bs] * bs + pos % bs
             context_lens[slot] = seq.seen_tokens + n
             logits_idx[slot] = cursor + n - 1
-            cursor += n
 
         return {
             "token_ids": token_ids, "token_slot": token_slot,

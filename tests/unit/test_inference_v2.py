@@ -249,9 +249,9 @@ def test_ragged_engine_with_kernel_path():
     orig = rl._paged_attention
 
     def forced(q, k_pool, v_pool, batch, block_size, use_kernel=None,
-               window=None):
+               window=None, prefill_tile=None):
         return orig(q, k_pool, v_pool, batch, block_size, use_kernel=True,
-                    window=window)
+                    window=window, prefill_tile=prefill_tile)
 
     params = _params()
     engine_ref = _v2_engine(params)
@@ -597,3 +597,88 @@ def test_v2_serialize_roundtrip(tmp_path):
             "kv_cache": {"block_size": 8}}))
     got = eng2.generate([prompt], max_new_tokens=5)[0]
     np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------------------------ #
+# Tiled prefill (reference ragged_ops/atom_builder work units)
+# ------------------------------------------------------------------ #
+def test_tiled_prefill_kernel_matches_xla():
+    from deepspeed_tpu.inference.v2.kernels import paged_prefill_attention
+    from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
+        _paged_attention)
+
+    rng = np.random.default_rng(15)
+    bs, nb, hkv, d, h, tile = 8, 12, 2, 16, 4, 16
+    k_pool = jnp.asarray(rng.normal(size=(nb * bs, hkv, d)).astype(np.float32))
+    v_pool = jnp.asarray(rng.normal(size=(nb * bs, hkv, d)).astype(np.float32))
+    tables = jnp.asarray([[1, 2, 3, 4], [5, 6, 0, 0]], jnp.int32)
+    # two tile-aligned chunks: seq0 rows 0..21 (pos 10..31), pads 22..31;
+    # seq1 rows 32..40 (pos 0..8), pads 41..47
+    T = 48
+    token_slot = np.zeros((T,), np.int32)
+    token_pos = np.full((T,), -1, np.int32)
+    token_slot[0:22] = 0
+    token_pos[0:22] = np.arange(10, 32)
+    token_slot[32:41] = 1
+    token_pos[32:41] = np.arange(0, 9)
+    q = jnp.asarray(rng.normal(size=(T, h, d)).astype(np.float32))
+    batch = {"block_tables": tables,
+             "token_slot": jnp.asarray(token_slot),
+             "token_pos": jnp.asarray(token_pos)}
+    ref = _paged_attention(q, k_pool, v_pool, batch, bs, use_kernel=False)
+    got = paged_prefill_attention(
+        q, k_pool, v_pool, tables, jnp.asarray(token_slot),
+        jnp.asarray(token_pos), block_size=bs, tile_q=tile)
+    real = np.r_[0:22, 32:41]
+    np.testing.assert_allclose(np.asarray(got)[real], np.asarray(ref)[real],
+                               rtol=2e-5, atol=2e-5)
+    # pad rows are exact zeros (not NaN)
+    pads = np.r_[22:32, 41:48]
+    assert np.all(np.asarray(got)[pads] == 0)
+
+
+def test_tiled_prefill_kernel_window_matches_xla():
+    from deepspeed_tpu.inference.v2.kernels import paged_prefill_attention
+    from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
+        _paged_attention)
+
+    rng = np.random.default_rng(16)
+    bs, nb, hkv, d, h, tile, W = 8, 12, 2, 16, 4, 16, 12
+    k_pool = jnp.asarray(rng.normal(size=(nb * bs, hkv, d)).astype(np.float32))
+    v_pool = jnp.asarray(rng.normal(size=(nb * bs, hkv, d)).astype(np.float32))
+    tables = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    T = 32
+    token_slot = np.zeros((T,), np.int32)
+    token_pos = np.full((T,), -1, np.int32)
+    token_pos[0:30] = np.arange(0, 30)
+    q = jnp.asarray(rng.normal(size=(T, h, d)).astype(np.float32))
+    batch = {"block_tables": tables,
+             "token_slot": jnp.asarray(token_slot),
+             "token_pos": jnp.asarray(token_pos)}
+    ref = _paged_attention(q, k_pool, v_pool, batch, bs, use_kernel=False,
+                           window=W)
+    got = paged_prefill_attention(
+        q, k_pool, v_pool, tables, jnp.asarray(token_slot),
+        jnp.asarray(token_pos), block_size=bs, tile_q=tile, window=W)
+    np.testing.assert_allclose(np.asarray(got)[:30], np.asarray(ref)[:30],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_engine_tiled_prefill_matches_sequential():
+    """Long prompts trigger tile-aligned packing + the tiled kernel path;
+    tokens must equal the v1 reference exactly."""
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(0, CFG.vocab_size, size=(n,)).tolist()
+               for n in (17, 20)]
+    params = _params()
+    ref = _v1_reference_tokens(params, prompts, n_new=5)
+
+    eng = _v2_engine(params, token_budget=64, block_size=8, max_context=64)
+    eng.PREFILL_TILE = 16   # prompts (17, 20) >= tile -> tiled path
+    # monkeypatch-free check that the tiled program was built
+    out = eng.generate(prompts, max_new_tokens=5)
+    assert any(k[1] == 16 for k in eng._steps if isinstance(k, tuple)
+               and len(k) == 2 and not isinstance(k[0], str)), \
+        list(eng._steps)
+    for got, want in zip(out, ref):
+        np.testing.assert_array_equal(got, np.asarray(want))
