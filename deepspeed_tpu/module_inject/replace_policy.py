@@ -81,6 +81,38 @@ register_policy("bert", [
 ])
 register_policy("distilbert", POLICY_REGISTRY["bert"])
 
+# --------------------------------------------------------------------- #
+# Vision / diffusers surface (reference containers/{clip,unet,vae}.py +
+# csrc/spatial/csrc/opt_bias_add.cu). TP covers the transformer blocks —
+# attention q/k/v column-split, out row-split, MLP in/out split; conv and
+# (group)norm layers stay replicated: on TPU, XLA already fuses the
+# bias+add+conv chains the reference's spatial CUDA kernels hand-fuse,
+# and sharding convs over 'model' buys nothing at these widths.
+# --------------------------------------------------------------------- #
+register_policy("clip", [
+    (r"token_embedding/embedding", P("model", None)),
+    (r"(q_proj|k_proj|v_proj)/kernel", P(None, "model")),
+    (r"out_proj/kernel", P("model", None)),
+    (r"fc1/kernel", P(None, "model")),
+    (r"fc2/kernel", P("model", None)),
+    (r"patch_embedding.*", P()),      # conv stem replicated
+    (r".*layer_?norm.*", P()),
+])
+register_policy("vit", POLICY_REGISTRY["clip"])
+
+register_policy("unet", [
+    (r"(to_q|to_k|to_v)/kernel", P(None, "model")),
+    (r"to_out.*/kernel", P("model", None)),
+    (r"ff/net_0.*/kernel", P(None, "model")),
+    (r"ff/net_2/kernel", P("model", None)),
+    (r".*(conv|norm|time_emb).*", P()),  # spatial path replicated
+])
+register_policy("vae", [
+    (r"(to_q|to_k|to_v)/kernel", P(None, "model")),
+    (r"to_out.*/kernel", P("model", None)),
+    (r".*(conv|norm).*", P()),
+])
+
 
 def policy_for(architecture: str) -> Optional[List[Tuple[str, Any]]]:
     """Rules for an architecture name (case-insensitive; accepts HF-style

@@ -225,12 +225,25 @@ class DeepSpeedEngine:
         self._offload_ratio = (zc.offload_optimizer.ratio
                                if self._offload_device else 0.0)
         self._offload_plan = None  # built with the shardings
-        if zc.offload_param is not None and \
-                zc.offload_param.device not in (None, "none"):
-            logger.warning(
-                "offload_param is accepted but NOT implemented yet: "
-                "compute-precision params stay on device (stage-3 keeps them "
-                "sharded); host/NVMe param offload lands with the AIO swapper")
+        # offload_param (the other half of ZeRO-Infinity, reference
+        # zero/partition_parameters.py NVMe path): compute-precision params
+        # are HOST-resident between steps; each forward stages them to HBM
+        # and the step's epilogue streams them back. HBM then holds params
+        # only while a program is computing.
+        self._offload_param_device = validate_offload_config(
+            zc.offload_param, self.zero_stage, "offload_param")
+        if self._offload_param_device is not None:
+            if self.zero_stage < 3:
+                raise ValueError(
+                    "offload_param requires ZeRO stage 3 (reference "
+                    "constraint: only stage 3 partitions parameters)")
+            if self._offload_param_device == "nvme":
+                raise NotImplementedError(
+                    "offload_param to NVMe is not implemented yet — "
+                    "host ('cpu') param offload is; NVMe currently covers "
+                    "optimizer state (offload_optimizer.device='nvme')")
+        self._param_offload_plan = None  # built with the shardings
+        self._params_on_host = False
         self.base_param_specs = base_param_specs
         if self.base_param_specs is None:
             self.base_param_specs = getattr(model, "partition_rules", None)
@@ -434,6 +447,16 @@ class DeepSpeedEngine:
                 f"{self._offload_device} "
                 f"({self._offload_plan.fraction:.0%} of elements, "
                 f"ratio={self._offload_ratio})", ranks=[0])
+        if self._offload_param_device:
+            from deepspeed_tpu.runtime.zero.offload import OffloadPlan
+
+            self._param_offload_plan = OffloadPlan(
+                params_shapes, ratio=1.0,
+                device=self._offload_param_device)
+            log_dist(
+                "ZeRO-Infinity: compute params host-resident between "
+                "steps (offload_param.device="
+                f"{self._offload_param_device})", ranks=[0])
         return self._shardings
 
     def _state_shardings(self):
@@ -450,6 +473,7 @@ class DeepSpeedEngine:
             out_shardings=dict(sh))(host_params)
         if self._offload_plan is not None:
             self._offload_transfer(to_host=True)
+        self._param_offload_transfer(to_host=True)
 
     def initialize_parameters(self, *sample_args, seed: Optional[int] = None):
         """Construct params directly sharded (the reference's ``zero.Init``
@@ -468,6 +492,7 @@ class DeepSpeedEngine:
         self.state = jax.jit(build, out_shardings=dict(sh))(rng, *sample_args)
         if self._offload_plan is not None:
             self._offload_transfer(to_host=True)
+        self._param_offload_transfer(to_host=True)
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
         log_dist(f"initialized {n_params/1e6:.2f}M parameters", ranks=[0])
         return self.state
@@ -707,6 +732,7 @@ class DeepSpeedEngine:
                 and self.config.gradient_accumulation_steps == 1
                 and not self._onebit
                 and self._offload_plan is None and not self._offload_device
+                and not self._offload_param_device
                 and not zc.zero_quantized_gradients
                 and not (zc.zero_quantized_weights and self.zero_stage >= 3)
                 and not self.config.flops_profiler.enabled
@@ -797,6 +823,7 @@ class DeepSpeedEngine:
         scan_unsupported = (
             self._onebit or self._offload_plan is not None
             or bool(self._offload_device)
+            or bool(self._offload_param_device)
             or zc.zero_quantized_gradients
             or (zc.zero_quantized_weights and self.zero_stage >= 3)
             # profiler/breakdown instrument the per-micro programs, which
@@ -858,6 +885,7 @@ class DeepSpeedEngine:
         if self.state is None:
             self.initialize_parameters(*args)
         args = self.shard_batch(args)
+        self._param_offload_transfer(to_host=False)
         self._rng, rng = jax.random.split(self._rng)
         if not self.training:
             if self._jit_eval is None:
@@ -954,6 +982,18 @@ class DeepSpeedEngine:
                           swap_prefix=f"opt_{k}")
             for k, v in self.state["opt"].items()}
 
+    def _param_offload_transfer(self, to_host: bool):
+        """Stream the compute-precision params host<->device
+        (offload_param — ZeRO-Infinity's param tier at host granularity:
+        HBM holds params only while a program runs)."""
+        if self._param_offload_plan is None or \
+                self._params_on_host == to_host:
+            return
+        self.state["params"] = self._param_offload_plan.place(
+            self.state["params"], self._shardings["params"],
+            to_host=to_host, swap_prefix="params")
+        self._params_on_host = to_host
+
     def step(self):
         """Optimizer step at gradient-accumulation boundaries.
         (reference engine.step:2111 -> _take_model_step:2045)"""
@@ -1005,6 +1045,7 @@ class DeepSpeedEngine:
         self.tput_timer.stop(
             global_step=True,
             sync_obj=self.state["loss_scale"] if tput_sync else None)
+        self._param_offload_transfer(to_host=True)
         self.global_steps += 1
         self._accum_pending = False
         self._update_data_efficiency()
@@ -1212,6 +1253,8 @@ class DeepSpeedEngine:
         self._pending_step = None
         if self._offload_plan is not None:
             self._offload_transfer(to_host=True)  # restore host residency
+        self._params_on_host = False  # loaded arrays are device-placed
+        self._param_offload_transfer(to_host=True)
         if client_state:
             self.global_steps = int(client_state.get("global_steps", 0))
             self.global_samples = int(client_state.get("global_samples", 0))

@@ -154,7 +154,7 @@ def test_replace_policy_registry():
         POLICY_REGISTRY, policy_for, replace_module)
 
     assert {"llama", "gpt2", "opt", "bloom", "gptj", "bert",
-            "mixtral"} <= set(POLICY_REGISTRY)
+            "mixtral", "clip", "vit", "unet", "vae"} <= set(POLICY_REGISTRY)
     # HF-style class names resolve
     assert policy_for("LlamaForCausalLM") is POLICY_REGISTRY["llama"]
     assert policy_for("BloomForCausalLM") is POLICY_REGISTRY["bloom"]
