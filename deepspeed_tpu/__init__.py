@@ -107,11 +107,39 @@ def initialize(args=None,
     return engine, engine.optimizer, dataloader, engine.lr_scheduler
 
 
-def init_inference(model: Any = None, config: Any = None, **kwargs):
-    """Inference engine entry (reference: deepspeed/__init__.py:269)."""
+def init_inference(model: Any = None, config: Any = None,
+                   checkpoint: Any = None, **kwargs):
+    """Inference engine entry (reference: deepspeed/__init__.py:269).
+
+    ``checkpoint`` may be a HuggingFace checkpoint directory: the model is
+    built from its ``config.json`` (when ``model`` is None) and the real
+    weights are loaded pre-sharded (reference ``load_model_with_checkpoint``
+    via the checkpoint-json path of ``init_inference``).
+    """
     from deepspeed_tpu.inference.engine import InferenceEngine
 
-    return InferenceEngine(model=model, config=config, **kwargs)
+    if checkpoint is not None and model is None:
+        from deepspeed_tpu.checkpoint.hf_loader import model_from_hf
+        from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+        # normalize "bf16"/"fp16"-style aliases through the inference
+        # config before they reach the model config, so the model computes
+        # in the same dtype the engine casts the weights to — mirroring
+        # the engine's own config-then-kwargs merge order
+        import dataclasses as _dc
+
+        if isinstance(config, DeepSpeedInferenceConfig):
+            cfg_dict = _dc.asdict(config)
+        else:
+            cfg_dict = dict(config or {})
+        if "dtype" in kwargs:
+            cfg_dict["dtype"] = kwargs["dtype"]
+        dtype = DeepSpeedInferenceConfig.from_dict(cfg_dict).dtype
+        _arch, _cfg, model = model_from_hf(checkpoint, dtype)
+    engine = InferenceEngine(model=model, config=config, **kwargs)
+    if checkpoint is not None:
+        engine.load_checkpoint(checkpoint)
+    return engine
 
 
 def add_config_arguments(parser):

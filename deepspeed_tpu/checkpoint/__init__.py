@@ -4,6 +4,13 @@ from deepspeed_tpu.checkpoint.engine import (
     load_engine_state,
     save_engine_state,
 )
+from deepspeed_tpu.checkpoint.hf_loader import (
+    HFLoadError,
+    config_from_hf,
+    hf_config,
+    load_hf_checkpoint,
+)
 
 __all__ = ["AsyncCheckpointEngine", "CheckpointEngine", "save_engine_state",
-           "load_engine_state"]
+           "load_engine_state", "load_hf_checkpoint", "config_from_hf",
+           "hf_config", "HFLoadError"]

@@ -1,0 +1,424 @@
+"""HuggingFace checkpoint ingestion: safetensors/torch-bin -> flax param
+trees for the deepspeed_tpu model families.
+
+Reference analog: ``inference/engine.py:331 load_model_with_checkpoint`` +
+the per-architecture weight maps in ``module_inject/containers/`` (~2.3k
+LoC of qkv/mlp categorization) + ``runtime/state_dict_factory.py:427``
+auto-categorization.  The TPU form is a NAME MAP per architecture: each
+entry rewrites one HF tensor name to a path in our param tree plus a
+layout transform (torch ``nn.Linear`` stores ``[out, in]``; flax ``Dense``
+kernels are ``[in, out]`` — GPT-2's Conv1D is the exception and ships
+``[in, out]`` already).  Mixture models additionally STACK per-expert
+tensors onto a leading expert axis (our grouped-einsum layout,
+moe/sharded_moe.py ``ExpertsFFN``).
+
+Pre-sharded landing: pass ``mesh`` (+ optional ``rules``) and every tensor
+is ``jax.device_put`` against its :func:`policy_for` PartitionSpec the
+moment it is read — no step ever holds a full unsharded model copy on
+device, and the host side reads straight from the (memory-mapped)
+safetensors file.
+
+Supported layouts: single ``model.safetensors``, sharded
+``model.safetensors.index.json``, and ``pytorch_model.bin`` fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["load_hf_checkpoint", "config_from_hf", "hf_config",
+           "HFLoadError"]
+
+
+class HFLoadError(RuntimeError):
+    pass
+
+
+# --------------------------------------------------------------------- #
+# Tensor iteration over the on-disk layouts
+# --------------------------------------------------------------------- #
+def _iter_safetensors(path: str):
+    from safetensors import safe_open
+
+    try:
+        f = safe_open(path, framework="flax")
+    except Exception:  # noqa: BLE001 — older safetensors: numpy framework
+        f = safe_open(path, framework="np")
+    with f:
+        for name in f.keys():
+            yield name, f.get_tensor(name)
+
+
+def _iter_torch_bin(path: str):
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    for name, t in sd.items():
+        if t.dtype in (torch.bfloat16, torch.float16):
+            yield name, t.to(torch.float32).numpy()
+        else:
+            yield name, t.numpy()
+
+
+def iter_checkpoint_tensors(model_path: str):
+    """Yield ``(hf_name, array)`` over every tensor in the checkpoint
+    directory, resolving sharded safetensors indexes."""
+    st = os.path.join(model_path, "model.safetensors")
+    idx = os.path.join(model_path, "model.safetensors.index.json")
+    bin_ = os.path.join(model_path, "pytorch_model.bin")
+    bin_idx = os.path.join(model_path, "pytorch_model.bin.index.json")
+    if os.path.exists(idx) or os.path.exists(bin_idx):
+        index = idx if os.path.exists(idx) else bin_idx
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        files = sorted(set(weight_map.values()))
+        it = (_iter_safetensors if index == idx else _iter_torch_bin)
+        for fn in files:
+            yield from it(os.path.join(model_path, fn))
+    elif os.path.exists(st):
+        yield from _iter_safetensors(st)
+    elif os.path.exists(bin_):
+        yield from _iter_torch_bin(bin_)
+    else:
+        raise HFLoadError(
+            f"no model.safetensors(.index.json) or pytorch_model.bin "
+            f"under {model_path}")
+
+
+# --------------------------------------------------------------------- #
+# Architecture name maps.  Each rule: (regex, target builder) where the
+# builder receives the match and returns (path_tuple, transform) —
+# transform "t" = transpose, None = as-is, ("stack", axis_index) = stack
+# into the leading expert axis at position axis_index.
+# --------------------------------------------------------------------- #
+Rule = Tuple[str, Callable[[re.Match], Tuple[Tuple[str, ...], Any]]]
+
+
+def _llama_rules() -> List[Rule]:
+    return [
+        (r"^model\.embed_tokens\.weight$",
+         lambda m: (("model", "embed_tokens", "embedding"), None)),
+        (r"^model\.layers\.(\d+)\.self_attn\.(q|k|v|o)_proj\.weight$",
+         lambda m: (("model", f"layers_{m.group(1)}", "self_attn",
+                     f"{m.group(2)}_proj", "kernel"), "t")),
+        (r"^model\.layers\.(\d+)\.mlp\.(gate|up|down)_proj\.weight$",
+         lambda m: (("model", f"layers_{m.group(1)}", "mlp",
+                     f"{m.group(2)}_proj", "kernel"), "t")),
+        (r"^model\.layers\.(\d+)\.(input_layernorm|post_attention_layernorm)"
+         r"\.weight$",
+         lambda m: (("model", f"layers_{m.group(1)}", m.group(2), "scale"),
+                    None)),
+        (r"^model\.norm\.weight$", lambda m: (("model", "norm", "scale"),
+                                              None)),
+        (r"^lm_head\.weight$", lambda m: (("lm_head", "kernel"), "t")),
+        (r".*rotary_emb\.inv_freq$", lambda m: (None, None)),  # recomputed
+    ]
+
+
+def _mixtral_rules() -> List[Rule]:
+    # our Mixtral tree is flat (no "model" wrapper) and the MoE block is
+    # moe/layer.py MoE -> deepspeed_moe -> {gate/wg, experts/w_*}
+    hf2us = {"w1": "w_gate", "w3": "w_up", "w2": "w_down"}
+    return [
+        (r"^model\.embed_tokens\.weight$",
+         lambda m: (("embed_tokens", "embedding"), None)),
+        (r"^model\.layers\.(\d+)\.self_attn\.(q|k|v|o)_proj\.weight$",
+         lambda m: ((f"layers_{m.group(1)}", "self_attn",
+                     f"{m.group(2)}_proj", "kernel"), "t")),
+        (r"^model\.layers\.(\d+)\.block_sparse_moe\.gate\.weight$",
+         lambda m: ((f"layers_{m.group(1)}", "block_sparse_moe",
+                     "deepspeed_moe", "gate", "wg", "kernel"), "t")),
+        (r"^model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\."
+         r"(w1|w2|w3)\.weight$",
+         lambda m: ((f"layers_{m.group(1)}", "block_sparse_moe",
+                     "deepspeed_moe", "experts", hf2us[m.group(3)]),
+                    ("stack", int(m.group(2))))),
+        (r"^model\.layers\.(\d+)\.(input_layernorm|post_attention_layernorm)"
+         r"\.weight$",
+         lambda m: ((f"layers_{m.group(1)}", m.group(2), "scale"), None)),
+        (r"^model\.norm\.weight$", lambda m: (("norm", "scale"), None)),
+        (r"^lm_head\.weight$", lambda m: (("lm_head", "kernel"), "t")),
+    ]
+
+
+def _gpt2_rules() -> List[Rule]:
+    # GPT-2 Conv1D weights are already [in, out] — no transpose
+    return [
+        (r"^(transformer\.)?wte\.weight$",
+         lambda m: (("wte", "embedding"), None)),
+        (r"^(transformer\.)?wpe\.weight$",
+         lambda m: (("wpe", "embedding"), None)),
+        (r"^(transformer\.)?h\.(\d+)\.(ln_1|ln_2)\.(weight|bias)$",
+         lambda m: ((f"h_{m.group(2)}", m.group(3),
+                     "scale" if m.group(4) == "weight" else "bias"), None)),
+        (r"^(transformer\.)?h\.(\d+)\.attn\.c_attn\.(weight|bias)$",
+         lambda m: ((f"h_{m.group(2)}", "c_attn",
+                     "kernel" if m.group(3) == "weight" else "bias"), None)),
+        (r"^(transformer\.)?h\.(\d+)\.attn\.c_proj\.(weight|bias)$",
+         lambda m: ((f"h_{m.group(2)}", "attn_out",
+                     "kernel" if m.group(3) == "weight" else "bias"), None)),
+        (r"^(transformer\.)?h\.(\d+)\.mlp\.(c_fc|c_proj)\.(weight|bias)$",
+         lambda m: ((f"h_{m.group(2)}", m.group(3),
+                     "kernel" if m.group(4) == "weight" else "bias"), None)),
+        (r"^(transformer\.)?ln_f\.(weight|bias)$",
+         lambda m: (("ln_f",
+                     "scale" if m.group(2) == "weight" else "bias"), None)),
+        (r"^lm_head\.weight$", lambda m: (None, None)),  # tied to wte
+        (r".*\.attn\.(bias|masked_bias)$", lambda m: (None, None)),
+    ]
+
+
+def _opt_rules() -> List[Rule]:
+    def leaf(kind):  # weight->kernel (transposed), bias->bias
+        return ("kernel", "t") if kind == "weight" else ("bias", None)
+
+    def lin(m):
+        name, t = leaf(m.group(3))
+        return ((f"layers_{m.group(1)}", "self_attn", m.group(2), name), t)
+
+    def fc(m):
+        name, t = leaf(m.group(3))
+        return ((f"layers_{m.group(1)}", m.group(2), name), t)
+
+    return [
+        (r"^(model\.decoder|decoder)\.embed_tokens\.weight$",
+         lambda m: (("embed_tokens", "embedding"), None)),
+        (r"^(model\.decoder|decoder)\.embed_positions\.weight$",
+         lambda m: (("embed_positions", "embedding"), None)),
+        (r"^(?:model\.decoder|decoder)\.layers\.(\d+)\.self_attn\."
+         r"(q_proj|k_proj|v_proj|out_proj)\.(weight|bias)$", lin),
+        (r"^(?:model\.decoder|decoder)\.layers\.(\d+)\.(fc1|fc2)\."
+         r"(weight|bias)$", fc),
+        (r"^(?:model\.decoder|decoder)\.layers\.(\d+)\."
+         r"(?:self_attn_layer_norm)\.(weight|bias)$",
+         lambda m: ((f"layers_{m.group(1)}", "self_attn_layer_norm",
+                     "scale" if m.group(2) == "weight" else "bias"), None)),
+        (r"^(?:model\.decoder|decoder)\.layers\.(\d+)\.final_layer_norm\."
+         r"(weight|bias)$",
+         lambda m: ((f"layers_{m.group(1)}", "final_layer_norm",
+                     "scale" if m.group(2) == "weight" else "bias"), None)),
+        (r"^(?:model\.decoder|decoder)\.final_layer_norm\.(weight|bias)$",
+         lambda m: (("final_layer_norm",
+                     "scale" if m.group(1) == "weight" else "bias"), None)),
+        (r"^lm_head\.weight$", lambda m: (None, None)),  # tied
+    ]
+
+
+_ARCH_RULES: Dict[str, Callable[[], List[Rule]]] = {
+    "llama": _llama_rules,
+    "mistral": _llama_rules,     # same architecture/serialization
+    "internlm": _llama_rules,
+    "mixtral": _mixtral_rules,
+    "gpt2": _gpt2_rules,
+    "opt": _opt_rules,
+}
+
+
+# --------------------------------------------------------------------- #
+# Config translation
+# --------------------------------------------------------------------- #
+def hf_config(model_path: str) -> Dict[str, Any]:
+    with open(os.path.join(model_path, "config.json")) as f:
+        return json.load(f)
+
+
+def config_from_hf(model_path: str, dtype: Any = None):
+    """Build the matching deepspeed_tpu model config from a HF
+    ``config.json``.  Returns ``(architecture, config)``."""
+    import jax.numpy as jnp
+
+    cfg = hf_config(model_path)
+    arch = cfg.get("model_type", "").lower()
+    dt = dtype if dtype is not None else jnp.bfloat16
+    if arch in ("llama", "mistral", "internlm"):
+        from deepspeed_tpu.models.llama import LlamaConfig
+
+        return arch, LlamaConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg["intermediate_size"],
+            num_hidden_layers=cfg["num_hidden_layers"],
+            num_attention_heads=cfg["num_attention_heads"],
+            num_key_value_heads=cfg.get(
+                "num_key_value_heads", cfg["num_attention_heads"]),
+            max_position_embeddings=cfg["max_position_embeddings"],
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            sliding_window=cfg.get("sliding_window"),
+            dtype=dt)
+    if arch == "mixtral":
+        from deepspeed_tpu.models.mixtral import MixtralConfig
+
+        return arch, MixtralConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg["intermediate_size"],
+            num_hidden_layers=cfg["num_hidden_layers"],
+            num_attention_heads=cfg["num_attention_heads"],
+            num_key_value_heads=cfg.get(
+                "num_key_value_heads", cfg["num_attention_heads"]),
+            max_position_embeddings=cfg["max_position_embeddings"],
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            num_local_experts=cfg.get("num_local_experts", 8),
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+            dtype=dt)
+    if arch == "gpt2":
+        from deepspeed_tpu.models.gpt2 import GPT2Config
+
+        return arch, GPT2Config(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["n_embd"],
+            num_hidden_layers=cfg["n_layer"],
+            num_attention_heads=cfg["n_head"],
+            max_position_embeddings=cfg["n_positions"],
+            layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-5),
+            dtype=dt)
+    if arch == "opt":
+        from deepspeed_tpu.models.opt import OPTConfig
+
+        return arch, OPTConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            ffn_dim=cfg["ffn_dim"],
+            num_hidden_layers=cfg["num_hidden_layers"],
+            num_attention_heads=cfg["num_attention_heads"],
+            max_position_embeddings=cfg["max_position_embeddings"],
+            do_layer_norm_before=cfg.get("do_layer_norm_before", True),
+            dtype=dt)
+    raise HFLoadError(f"unsupported model_type {arch!r} in {model_path}")
+
+
+# --------------------------------------------------------------------- #
+# Loader
+# --------------------------------------------------------------------- #
+def _spec_for(path: Tuple[str, ...], rules) -> Any:
+    from jax.sharding import PartitionSpec as P
+
+    name = "/".join(path)
+    for pat, spec in rules:
+        if re.search(pat, name):
+            return spec
+    return P()
+
+
+def load_hf_checkpoint(model_path: str, architecture: Optional[str] = None,
+                       dtype: Any = None, mesh: Any = None,
+                       rules: Any = None, strict: bool = True,
+                       to_device: bool = True):
+    """Load a HF checkpoint directory into a deepspeed_tpu flax param tree.
+
+    ``architecture`` defaults to config.json's ``model_type``.  ``dtype``
+    casts every tensor (e.g. ``jnp.bfloat16`` for serving, ``jnp.float32``
+    for training masters); None keeps the stored dtype.  With ``mesh``
+    each tensor lands pre-sharded by its policy PartitionSpec (``rules``
+    overrides :func:`policy_for`'s registry lookup).  ``strict`` raises on
+    unmapped tensor names instead of skipping them.  ``to_device=False``
+    keeps every tensor on the HOST (numpy) — for consumers that stream
+    leaves through their own placement/quantization (at most one tensor
+    transits the device at a time, never the full tree).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not to_device and mesh is not None:
+        raise ValueError(
+            "to_device=False keeps tensors on the host; it cannot be "
+            "combined with mesh= (which device_puts every tensor)")
+    if architecture is None:
+        architecture = hf_config(model_path).get("model_type", "")
+    arch = architecture.lower()
+    if arch not in _ARCH_RULES:
+        raise HFLoadError(
+            f"no HF name map for architecture {arch!r} "
+            f"(have: {sorted(_ARCH_RULES)})")
+    rule_list = [(re.compile(p), fn) for p, fn in _ARCH_RULES[arch]()]
+    if mesh is not None and rules is None:
+        from deepspeed_tpu.module_inject.replace_policy import policy_for
+
+        rules = policy_for(arch)
+        if rules is None:
+            raise HFLoadError(f"no TP policy registered for {arch!r}")
+
+    tree: Dict[str, Any] = {}
+    stacks: Dict[Tuple[str, ...], Dict[int, Any]] = {}
+
+    def place(path, arr):
+        if not to_device and mesh is None:
+            arr = np.asarray(jax.device_get(arr)
+                             if isinstance(arr, jax.Array) else arr)
+            if dtype is not None:
+                arr = arr.astype(np.dtype(jnp.dtype(dtype)))
+        elif dtype is not None:
+            arr = jnp.asarray(arr, dtype=dtype)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            arr = jax.device_put(
+                arr, NamedSharding(mesh, _spec_for(path, rules)))
+        elif to_device:
+            arr = jnp.asarray(arr)
+        node = tree
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = arr
+
+    unmapped = []
+    for name, tensor in iter_checkpoint_tensors(model_path):
+        for pat, fn in rule_list:
+            m = pat.match(name)
+            if m is None:
+                continue
+            path, tf = fn(m)
+            if path is None:            # deliberately skipped tensor
+                break
+            if isinstance(tf, tuple) and tf[0] == "stack":
+                stacks.setdefault(path, {})[tf[1]] = np.asarray(tensor).T
+            else:
+                arr = tensor.T if tf == "t" else tensor
+                place(path, arr)
+            break
+        else:
+            unmapped.append(name)
+    if unmapped and strict:
+        raise HFLoadError(
+            f"unmapped tensors for {arch}: {unmapped[:8]}"
+            + (f" (+{len(unmapped) - 8} more)" if len(unmapped) > 8 else ""))
+    for path, parts in stacks.items():
+        n = max(parts) + 1
+        if set(parts) != set(range(n)):
+            raise HFLoadError(
+                f"missing expert shards for {'/'.join(path)}: "
+                f"have {sorted(parts)}")
+        place(path, np.stack([parts[i] for i in range(n)]))
+    return tree
+
+
+def model_from_hf(model_path: str, dtype: Any = None):
+    """Build the matching deepspeed_tpu flax module for a HF checkpoint
+    directory.  Returns ``(architecture, config, module)`` — pair with
+    :func:`load_hf_checkpoint` for the params."""
+    arch, cfg = config_from_hf(model_path, dtype)
+    if arch in ("llama", "mistral", "internlm"):
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+
+        return arch, cfg, LlamaForCausalLM(cfg)
+    if arch == "mixtral":
+        from deepspeed_tpu.models.mixtral import MixtralForCausalLM
+
+        return arch, cfg, MixtralForCausalLM(cfg)
+    if arch == "gpt2":
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+
+        return arch, cfg, GPT2LMHeadModel(cfg)
+    if arch == "opt":
+        from deepspeed_tpu.models.opt import OPTForCausalLM
+
+        return arch, cfg, OPTForCausalLM(cfg)
+    raise HFLoadError(f"no model class for architecture {arch!r}")

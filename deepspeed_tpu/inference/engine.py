@@ -243,6 +243,31 @@ class InferenceEngine:
         slicer = self._param_sharding(host_params)
         self.params = slicer.shard_tree(jax.tree.map(cast, host_params))
 
+    def load_checkpoint(self, model_path: str):
+        """Load a real HuggingFace checkpoint directory (reference
+        ``load_model_with_checkpoint``, inference/engine.py:331).
+
+        Tensors land PRE-SHARDED: each one is ``device_put`` against its
+        TP PartitionSpec as it is read from the (memory-mapped)
+        safetensors file, so no device ever holds a full unsharded copy.
+        With weight-only quantization on, tensors are quantized
+        leaf-by-leaf on the way in instead (``_place_params``).
+        """
+        from deepspeed_tpu.checkpoint.hf_loader import load_hf_checkpoint
+
+        if self._weight_quantizer is not None:
+            # host-side tree: _place_params streams leaves through
+            # quantization one at a time, so the full-precision model is
+            # never device-resident (the point of weight-only serving)
+            tree = load_hf_checkpoint(model_path, dtype=self.dtype,
+                                      to_device=False)
+            self._place_params(tree)
+        else:
+            self.params = load_hf_checkpoint(
+                model_path, dtype=self.dtype, mesh=self.mesh,
+                rules=self._rules)
+        return self.params
+
     def init_parameters(self, sample_ids, seed: Optional[int] = None):
         """Random init, directly sharded (tests / pre-checkpoint smoke)."""
         rng = jax.random.key(seed if seed is not None else self.config.seed)
