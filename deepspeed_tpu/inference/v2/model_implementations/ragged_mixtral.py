@@ -86,7 +86,8 @@ class RaggedMixtral:
         return self.config.head_dim
 
     def __call__(self, params: Dict[str, Any], kv_cache: Dict[str, Any],
-                 batch: Dict[str, jax.Array], prefill_tile=None):
+                 batch: Dict[str, jax.Array], prefill_tile=None,
+                 decode=False):
         """Returns ``(logits [S, vocab], new_kv_cache)``."""
         cfg = self.config
         dt = cfg.dtype
@@ -105,7 +106,7 @@ class RaggedMixtral:
             out, new_cache[f"layer_{i}"] = ragged_attention_block(
                 lp["self_attn"], xa, kv_cache[f"layer_{i}"], batch,
                 self.block_size, cfg, h, hkv, d, cos, sin,
-                prefill_tile=prefill_tile)
+                prefill_tile=prefill_tile, decode_mode=decode)
             x = x + out
             xm = _rms_norm(x, lp["post_attention_layernorm"]["scale"],
                            cfg.rms_norm_eps)

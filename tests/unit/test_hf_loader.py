@@ -1,0 +1,246 @@
+"""HF checkpoint ingestion: real (tiny, randomly initialised) HuggingFace
+checkpoints saved with ``save_pretrained`` must load into our param trees
+and reproduce the HF logits (reference: inference/engine.py:331
+``load_model_with_checkpoint`` + module_inject/containers weight maps).
+
+Runs fully on the CPU mesh; transformers/torch execute the reference
+forward in fp32 and our flax models are run in fp32 for comparison.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from deepspeed_tpu.checkpoint.hf_loader import (  # noqa: E402
+    config_from_hf,
+    load_hf_checkpoint,
+    model_from_hf,
+)
+
+ATOL = 2e-4
+
+
+@pytest.fixture(autouse=True)
+def _seed_torch():
+    # transformers initialises random weights from torch's global RNG;
+    # pin it so every test sees the same checkpoint across runs
+    torch.manual_seed(0)
+
+
+def _save(tmp_path, model, config):
+    model.eval()
+    config.save_pretrained(tmp_path)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return str(tmp_path)
+
+
+def _hf_logits(model, ids):
+    with torch.no_grad():
+        return model(torch.from_numpy(ids)).logits.numpy()
+
+
+def test_llama_logits_match_hf(tmp_path):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    arch, cfg, module = model_from_hf(path, dtype=jnp.float32)
+    assert arch == "llama" and cfg.num_key_value_heads == 2
+    params = load_hf_checkpoint(path, dtype=jnp.float32)
+    ids = np.random.default_rng(0).integers(0, 256, size=(2, 12),
+                                            dtype=np.int64)
+    ours = np.asarray(module.apply({"params": params},
+                                   jnp.asarray(ids, jnp.int32)))
+    theirs = _hf_logits(hf, ids)
+    np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=1e-3)
+
+
+def test_mistral_swa_logits_match_hf(tmp_path):
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, sliding_window=8,
+        tie_word_embeddings=False)
+    hf = transformers.MistralForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    arch, cfg, module = model_from_hf(path, dtype=jnp.float32)
+    assert arch == "mistral" and cfg.sliding_window == 8
+    params = load_hf_checkpoint(path, dtype=jnp.float32)
+    # seq > window exercises the banded mask on both sides
+    ids = np.random.default_rng(1).integers(0, 256, size=(1, 24),
+                                            dtype=np.int64)
+    ours = np.asarray(module.apply({"params": params},
+                                   jnp.asarray(ids, jnp.int32)))
+    theirs = _hf_logits(hf, ids)
+    np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=1e-3)
+
+
+def test_gpt2_logits_match_hf(tmp_path):
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_positions=128,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    arch, cfg, module = model_from_hf(path, dtype=jnp.float32)
+    assert arch == "gpt2"
+    params = load_hf_checkpoint(path, dtype=jnp.float32)
+    ids = np.random.default_rng(2).integers(0, 256, size=(2, 10),
+                                            dtype=np.int64)
+    ours = np.asarray(module.apply({"params": params},
+                                   jnp.asarray(ids, jnp.int32)))
+    theirs = _hf_logits(hf, ids)
+    np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=1e-3)
+
+
+def test_opt_logits_match_hf(tmp_path):
+    hf_cfg = transformers.OPTConfig(
+        vocab_size=256, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=128,
+        do_layer_norm_before=True, word_embed_proj_dim=64)
+    hf = transformers.OPTForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    arch, cfg, module = model_from_hf(path, dtype=jnp.float32)
+    assert arch == "opt"
+    params = load_hf_checkpoint(path, dtype=jnp.float32)
+    ids = np.random.default_rng(3).integers(0, 256, size=(2, 9),
+                                            dtype=np.int64)
+    ours = np.asarray(module.apply({"params": params},
+                                   jnp.asarray(ids, jnp.int32)))
+    theirs = _hf_logits(hf, ids)
+    np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=1e-3)
+
+
+def test_mixtral_ragged_engine_matches_hf(tmp_path):
+    """Mixtral weights (per-expert tensors stacked onto the grouped-einsum
+    layout) through the FastGen ragged engine: the dropless MoE path must
+    reproduce HF's exact top-2 routing logits."""
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, num_local_experts=4,
+        num_experts_per_tok=2, tie_word_embeddings=False)
+    hf = transformers.MixtralForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    arch, cfg, _module = model_from_hf(path, dtype=jnp.float32)
+    assert arch == "mixtral" and cfg.num_local_experts == 4
+    params = load_hf_checkpoint(path, dtype=jnp.float32)
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.model_implementations.ragged_mixtral \
+        import RaggedMixtral
+
+    eng_cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": 16,
+                          "max_ragged_sequence_count": 2,
+                          "max_context": 32},
+        "kv_cache": {"block_size": 8},
+    })
+    eng = InferenceEngineV2(RaggedMixtral(cfg, 8), params, eng_cfg)
+    ids = np.random.default_rng(4).integers(0, 256, size=(1, 10),
+                                            dtype=np.int64)
+    logits = eng.put([1], [ids[0].tolist()])
+    eng.flush([1])
+    theirs = _hf_logits(hf, ids)[0, -1]
+    np.testing.assert_allclose(logits[1], theirs, atol=5e-4, rtol=1e-3)
+
+
+def test_presharded_landing(tmp_path):
+    """With a mesh, every loaded tensor lands with its policy
+    PartitionSpec (column-split q_proj, vocab-split embedding) and the
+    sharded forward matches the unsharded one."""
+    from jax.sharding import Mesh
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    devs = np.array(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devs, ("model",))
+    params = load_hf_checkpoint(path, dtype=jnp.float32, mesh=mesh)
+    q = params["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"]
+    emb = params["model"]["embed_tokens"]["embedding"]
+    assert q.sharding.spec == jax.sharding.PartitionSpec(None, "model")
+    assert emb.sharding.spec == jax.sharding.PartitionSpec("model", None)
+    # the sharded tree computes the same logits
+    _arch, _cfg, module = model_from_hf(path, dtype=jnp.float32)
+    ref = load_hf_checkpoint(path, dtype=jnp.float32)
+    ids = jnp.asarray(np.random.default_rng(5).integers(
+        0, 256, size=(1, 8)), jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(module.apply({"params": params}, ids)),
+        np.asarray(module.apply({"params": ref}, ids)), atol=1e-5)
+
+
+def test_v2_engine_from_hf_matches_hf_greedy(tmp_path):
+    """FastGen InferenceEngineV2.from_hf: generate() greedy tokens match
+    HF transformers generation token-for-token (north-star path: a real
+    checkpoint served through the ragged engine)."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+
+    eng_cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": 16,
+                          "max_ragged_sequence_count": 2,
+                          "max_context": 32},
+        "kv_cache": {"block_size": 8},
+    })
+    eng = InferenceEngineV2.from_hf(path, eng_cfg, dtype=jnp.float32)
+    ids = np.random.default_rng(7).integers(0, 256, size=(1, 8),
+                                            dtype=np.int64)
+    out = eng.generate([ids[0].tolist()], max_new_tokens=8)
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.from_numpy(ids), max_new_tokens=8, do_sample=False,
+            pad_token_id=0).numpy()[0, 8:]
+    # HF generate() early-stops at its eos_token_id; ours was not given
+    # one — compare the prefix HF actually produced
+    assert len(theirs) >= 1
+    np.testing.assert_array_equal(np.asarray(out[0])[:len(theirs)], theirs)
+
+
+def test_v1_engine_generate_from_hf(tmp_path):
+    """init_inference(checkpoint=hf_dir) end-to-end: greedy generate()
+    must match HF transformers' greedy generation token-for-token."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    import deepspeed_tpu
+
+    eng = deepspeed_tpu.init_inference(checkpoint=path,
+                                       config={"dtype": jnp.float32})
+    ids = np.random.default_rng(6).integers(0, 256, size=(1, 8),
+                                            dtype=np.int64)
+    ours = np.asarray(eng.generate(jnp.asarray(ids, jnp.int32),
+                                   max_new_tokens=8))
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.from_numpy(ids), max_new_tokens=8, do_sample=False,
+            pad_token_id=0).numpy()
+    np.testing.assert_array_equal(ours[:, :theirs.shape[1]], theirs)

@@ -249,7 +249,7 @@ def test_ragged_engine_with_kernel_path():
     orig = rl._paged_attention
 
     def forced(q, k_pool, v_pool, batch, block_size, use_kernel=None,
-               window=None, prefill_tile=None):
+               window=None, prefill_tile=None, decode_mode=False):
         return orig(q, k_pool, v_pool, batch, block_size, use_kernel=True,
                     window=window, prefill_tile=prefill_tile)
 
@@ -511,6 +511,92 @@ def test_decode_loop_chunking_matches_put_loop():
     toks = eng2.decode_loop([1], [t0], steps=7)
     eng2.flush([1])
     np.testing.assert_array_equal([t0] + toks[0].tolist(), want)
+
+
+def test_decode_step_large_pool_matches_put_loop():
+    """A KV pool much larger than the live contexts (num_blocks set high)
+    must route decode to the bounded gather path, not the dense-pool
+    program, and still match the per-put() loop."""
+    params = _params()
+    cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": 16,
+                          "max_ragged_sequence_count": 2,
+                          "max_context": 32},
+        "kv_cache": {"block_size": 8, "num_blocks": 64},
+    })
+    eng = InferenceEngineV2(RaggedLlama(CFG, 8), params, cfg)
+    # pool rows (64*8=512) > 2 * S * C (2 * 2 * 4*8 = 128): gather path
+    assert 64 * 8 > 2 * 2 * (32 // 8) * 8
+    prompt = np.random.default_rng(21).integers(
+        0, CFG.vocab_size, size=(6,)).tolist()
+    logits = eng.put([1], [prompt])
+    t = int(np.argmax(logits[1]))
+    want = []
+    for _ in range(5):
+        logits = eng.put([1], [[t]])
+        t = int(np.argmax(logits[1]))
+        want.append(t)
+    eng.flush([1])
+
+    eng2 = InferenceEngineV2(RaggedLlama(CFG, 8), params, cfg)
+    logits = eng2.put([1], [prompt])
+    nxt = [int(np.argmax(logits[1]))]
+    got = []
+    for _ in range(5):
+        _lg, nxt = eng2.decode_step([1], nxt, greedy=True)
+        got.append(int(np.asarray(nxt)[0]))
+    eng2.flush([1])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_decode_step_matches_put_loop():
+    """decode_step (device-resident token feedback, one dispatch per
+    token) must produce the same greedy tokens as the per-put() loop,
+    including across a block-table growth boundary (block_size=8 with a
+    6-token prompt crosses into a new block at step 2) and across an
+    interleaved put() that invalidates the device-resident metadata."""
+    import jax.numpy as jnp
+
+    params = _params()
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, CFG.vocab_size, size=(6,)).tolist(),
+               rng.integers(0, CFG.vocab_size, size=(4,)).tolist()]
+
+    eng1 = _v2_engine(params)
+    logits = eng1.put([1, 2], prompts)
+    cur = {u: int(np.argmax(logits[u])) for u in (1, 2)}
+    want = {1: [cur[1]], 2: [cur[2]]}
+    for _ in range(10):
+        logits = eng1.put([1, 2], [[cur[1]], [cur[2]]])
+        cur = {u: int(np.argmax(logits[u])) for u in (1, 2)}
+        want[1].append(cur[1])
+        want[2].append(cur[2])
+    eng1.flush([1, 2])
+
+    eng2 = _v2_engine(params)
+    logits = eng2.put([1, 2], prompts)
+    got = {1: [], 2: []}
+    tok = [int(np.argmax(logits[1])), int(np.argmax(logits[2]))]
+    got[1].append(tok[0])
+    got[2].append(tok[1])
+    nxt = tok
+    for step in range(10):
+        lg, nxt = eng2.decode_step([1, 2], nxt, greedy=True)
+        host = np.asarray(nxt)[:2]
+        # greedy argmax inside the program == argmax of returned logits
+        np.testing.assert_array_equal(
+            host, np.argmax(np.asarray(lg[:2], np.float32), axis=-1))
+        got[1].append(int(host[0]))
+        got[2].append(int(host[1]))
+        if step == 4:
+            # interleaved scheduling activity forces a metadata
+            # re-upload on the next decode_step
+            eng2.put([9], [[5, 6, 7]])
+            eng2.flush([9])
+            nxt = jnp.asarray(host)
+    eng2.flush([1, 2])
+    np.testing.assert_array_equal(got[1], want[1])
+    np.testing.assert_array_equal(got[2], want[2])
 
 
 # ------------------------------------------------------------------ #
