@@ -39,12 +39,21 @@ from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
 from deepspeed_tpu.models.mixtral import MixtralConfig
 
 
-def dropless_moe(x, moe_params, k: int, dtype):
+def dropless_moe(x, moe_params, k: int, dtype, grouped=None):
     """Dropless top-k MoE over a flat token buffer.
 
     x: [T, H]; returns [T, H]. Router math in fp32 (reference TopKGate is
     fp32, sharded_moe.py:348); expert compute in ``dtype``.
+
+    The expert FFN runs through the grouped GEMM kernel
+    (ops/grouped_gemm.py — the reference's ★moe_gemm/★moe_scatter/
+    ★moe_gather pipeline): tokens are sorted by expert and each expert
+    multiplies only its own row block, so FLOPs scale with k·T instead
+    of E·T (4× fewer for Mixtral's 8-expert top-2).  ``grouped=False``
+    forces the dense all-experts einsum (the parity oracle).
     """
+    from deepspeed_tpu.ops.grouped_gemm import grouped_moe_ffn
+
     wg = moe_params["gate"]["wg"]["kernel"]            # [H, E]
     experts = moe_params["experts"]
     logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)   # [T, E]
@@ -52,12 +61,15 @@ def dropless_moe(x, moe_params, k: int, dtype):
     topv, topi = jax.lax.top_k(probs, k)               # [T, k]
     w = topv / jnp.maximum(jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
     e_count = wg.shape[1]
-    # combine weights [T, E]: w_t for selected experts, 0 otherwise
-    comb = jnp.sum(jax.nn.one_hot(topi, e_count, dtype=jnp.float32)
-                   * w[..., None], axis=1)             # [T, E]
     w_gate = experts["w_gate"].astype(dtype)           # [E, H, F]
     w_up = experts["w_up"].astype(dtype)
     w_down = experts["w_down"].astype(dtype)
+    if grouped is None or grouped:
+        return grouped_moe_ffn(x.astype(dtype), topi, w.astype(dtype),
+                               w_gate, w_up, w_down)
+    # dense all-experts composition (reference/oracle path)
+    comb = jnp.sum(jax.nn.one_hot(topi, e_count, dtype=jnp.float32)
+                   * w[..., None], axis=1)             # [T, E]
     xe = x.astype(dtype)
     h = jax.nn.silu(jnp.einsum("tm,emf->etf", xe, w_gate)) * \
         jnp.einsum("tm,emf->etf", xe, w_up)            # [E, T, F]

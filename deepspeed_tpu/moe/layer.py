@@ -31,6 +31,9 @@ class MoE(nn.Module):
     drop_tokens: bool = True
     dtype: Any = jnp.bfloat16
     mesh: Any = None
+    #: Megablocks-style dropless routing via the grouped GEMM kernel
+    #: (ops/grouped_gemm.py); see MOELayer.dropless
+    dropless: bool = False
 
     def _validate(self):
         if self.num_experts % max(1, self.ep_size) != 0:
@@ -49,6 +52,7 @@ class MoE(nn.Module):
             min_capacity=self.min_capacity,
             noisy_gate_policy=self.noisy_gate_policy,
             drop_tokens=self.drop_tokens, dtype=self.dtype, mesh=self.mesh,
+            dropless=self.dropless,
             name="deepspeed_moe")(hidden_states, train=train, rng=rng)
         if self.use_residual:
             # reference residual MoE (PR-MoE): dense FFN + learned mix

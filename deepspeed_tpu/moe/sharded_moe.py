@@ -123,10 +123,26 @@ class TopKGate(nn.Module):
     drop_tokens: bool = True
 
     @nn.compact
-    def __call__(self, x, train: bool = True, rng=None):
+    def __call__(self, x, train: bool = True, rng=None,
+                 dropless: bool = False):
         logits = nn.Dense(self.num_experts, use_bias=False,
                           dtype=jnp.float32, param_dtype=jnp.float32,
                           name="wg")(x.astype(jnp.float32))
+        if dropless:
+            # Megablocks-style routing: exact top-k with renormalised
+            # weights, NO capacity buckets (grouped GEMM handles the
+            # ragged per-expert token counts).  Returns
+            # (l_aux, topi [S,k], topw [S,k]).
+            probs = jax.nn.softmax(logits, axis=-1)
+            topv, topi = jax.lax.top_k(probs, self.k)
+            topw = topv / jnp.maximum(
+                jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(
+                jnp.sum(jax.nn.one_hot(topi, self.num_experts), axis=1),
+                axis=0) / self.k
+            l_aux = jnp.sum(me * ce) * self.num_experts
+            return l_aux, topi.astype(jnp.int32), topw
         cf = self.capacity_factor if train else self.eval_capacity_factor
         if self.k == 1:
             return top1gating(logits, cf, self.min_capacity,
@@ -149,7 +165,10 @@ class ExpertsFFN(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x):  # x: [E, C, M]
+    def __call__(self, x, grouped=None):
+        """x: [E, C, M] (capacity-dispatched) -> [E, C, M]; or, with
+        ``grouped=(topi, topw)``, x: [S, M] flat tokens -> [S, M] through
+        the grouped GEMM kernel (dropless — same params, no capacity)."""
         init = nn.initializers.lecun_normal()
         w_gate = self.param("w_gate", init,
                             (self.num_experts, self.hidden, self.intermediate),
@@ -160,6 +179,14 @@ class ExpertsFFN(nn.Module):
         w_down = self.param("w_down", init,
                             (self.num_experts, self.intermediate, self.hidden),
                             jnp.float32)
+        if grouped is not None:
+            from deepspeed_tpu.ops.grouped_gemm import grouped_moe_ffn
+
+            topi, topw = grouped
+            return grouped_moe_ffn(
+                x.astype(self.dtype), topi, topw.astype(self.dtype),
+                w_gate.astype(self.dtype), w_up.astype(self.dtype),
+                w_down.astype(self.dtype))
         h = nn.silu(jnp.einsum("ecm,emh->ech", x, w_gate.astype(self.dtype))) * \
             jnp.einsum("ecm,emh->ech", x, w_up.astype(self.dtype))
         return jnp.einsum("ech,ehm->ecm", h, w_down.astype(self.dtype))
@@ -181,12 +208,41 @@ class MOELayer(nn.Module):
     dtype: Any = jnp.bfloat16
     expert_axis: str = "expert"
     mesh: Any = None
+    #: Megablocks-style dropless MoE: exact top-k routing + grouped GEMM
+    #: (ops/grouped_gemm.py) instead of capacity dispatch.  No token is
+    #: ever dropped and no capacity padding is computed; requires
+    #: ep_size == 1 (expert weights replicated or TP-sharded) — the
+    #: capacity path remains the expert-parallel all-to-all form.
+    dropless: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True, rng=None):
         """x: [B, S, M] -> (out [B, S, M], l_aux)."""
         b, s, m = x.shape
         tokens = x.reshape(b * s, m)
+        if self.dropless:
+            mesh = self.mesh
+            if mesh is None:
+                from deepspeed_tpu.parallel import groups
+
+                if groups.is_initialized():
+                    mesh = groups.get_mesh()
+            if mesh is not None and mesh.shape.get(self.expert_axis, 1) > 1:
+                raise ValueError(
+                    "dropless MoE does not compose with expert "
+                    "parallelism yet — use the capacity path for ep>1")
+            if self.noisy_gate_policy is not None:
+                raise ValueError(
+                    "dropless MoE uses exact top-k routing; "
+                    "noisy_gate_policy is not supported with dropless=True")
+            l_aux, topi, topw = TopKGate(
+                self.num_experts, self.k, name="gate")(
+                    tokens, train=train, dropless=True)
+            out = ExpertsFFN(self.num_experts, self.hidden,
+                             self.intermediate, self.dtype,
+                             name="experts")(
+                tokens.astype(self.dtype), grouped=(topi, topw))
+            return out.reshape(b, s, m), l_aux.astype(jnp.float32)
         l_aux, combine, dispatch = TopKGate(
             self.num_experts, self.k, self.capacity_factor,
             self.eval_capacity_factor, self.min_capacity,
