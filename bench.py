@@ -73,33 +73,19 @@ def peak_flops_per_chip() -> float:
     return 197e12  # conservative default (cpu-sim prints are meaningless anyway)
 
 
-def main():
-    devs, backend_err = _probe_backend()
-    if devs is None:
-        print(json.dumps({"metric": "train_tokens_per_sec_per_chip_gpt125m",
-                          "value": 0, "unit": "tokens/s/chip",
-                          "vs_baseline": 0, "error": backend_err}))
-        return
-
+def _measure(heads: int, micro_batch: int, seq: int):
+    """One training-throughput measurement at the given head geometry.
+    Returns (tokens/s/chip, mfu, loss, step_ms, n_params, n_dev)."""
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
 
-    # ~125M-parameter Llama. TPU-first geometry: head_dim=128 (6 heads)
-    # instead of GPT-2's 12x64 — the MXU systolic array and vector lanes
-    # are 128 wide, so 64-dim heads run every attention matmul at half
-    # efficiency and double the softmax element count for identical
-    # parameter count, model FLOPs and hidden size.
     cfg_m = LlamaConfig(vocab_size=32000, hidden_size=768,
                         intermediate_size=2048, num_hidden_layers=12,
-                        num_attention_heads=6, num_key_value_heads=6,
+                        num_attention_heads=heads, num_key_value_heads=heads,
                         max_position_embeddings=2048, dtype=jnp.bfloat16)
-    seq = 1024
-    micro_batch = 16  # amortises the per-step fixed costs; measured +4%
-    # tok/s over 8 on v5e with no accuracy-relevant change
-
     ds_config = {
         "train_micro_batch_size_per_gpu": micro_batch,
         "gradient_accumulation_steps": 1,
@@ -141,8 +127,7 @@ def main():
     hard_sync()
     dt = time.perf_counter() - t0
 
-    tokens_per_sec = batch * seq * iters / dt
-    tokens_per_sec_per_chip = tokens_per_sec / n_dev
+    tokens_per_sec_per_chip = batch * seq * iters / dt / n_dev
 
     from deepspeed_tpu.utils.tensors import tree_num_params
 
@@ -152,18 +137,54 @@ def main():
         (6 * n_params)
     flops_per_token = 6 * n_params * (1 + att_flops)
     mfu = tokens_per_sec_per_chip * flops_per_token / peak_flops_per_chip()
+    return (tokens_per_sec_per_chip, mfu, float(jax.device_get(loss)),
+            1000 * dt / iters, n_params, n_dev)
+
+
+def main():
+    devs, backend_err = _probe_backend()
+    if devs is None:
+        print(json.dumps({"metric": "train_tokens_per_sec_per_chip_gpt125m",
+                          "value": 0, "unit": "tokens/s/chip",
+                          "vs_baseline": 0, "error": backend_err}))
+        return
+
+    seq = 1024
+    # HEADLINE metric: the original GPT-2-125M geometry so vs_baseline
+    # stays comparable across rounds against the fixed 0.54-MFU
+    # reference bar.
+    HEADLINE_HEADS, HEADLINE_MB = 12, 8
+    # Secondary: the TPU-first geometry (head_dim=128 fills the 128-wide
+    # MXU/vector lanes; same params, hidden size and model FLOPs) at the
+    # throughput-optimal micro-batch — reported separately, NOT in the
+    # headline, so geometry changes can never inflate vs_baseline.
+    TPU_HEADS, TPU_MB = 6, 16
+    tok_s, mfu, loss, step_ms, n_params, n_dev = _measure(
+        heads=HEADLINE_HEADS, micro_batch=HEADLINE_MB, seq=seq)
+    tok_s2, mfu2, _loss2, step_ms2, _, _ = _measure(
+        heads=TPU_HEADS, micro_batch=TPU_MB, seq=seq)
 
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip_gpt125m",
-        "value": round(tokens_per_sec_per_chip, 1),
+        "value": round(tok_s, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.54, 4),
         "extra": {
             "mfu": round(mfu, 4),
-            "loss": float(jax.device_get(loss)),
+            "loss": loss,
             "params_m": round(n_params / 1e6, 1),
-            "seq": seq, "batch": batch, "n_devices": n_dev,
-            "step_time_ms": round(1000 * dt / iters, 2),
+            "seq": seq, "batch": HEADLINE_MB * n_dev, "n_devices": n_dev,
+            "step_time_ms": round(step_ms, 2),
+            "heads": HEADLINE_HEADS,
+            "head_dim": 768 // HEADLINE_HEADS,
+            "micro_batch": HEADLINE_MB,
+            "tpu_geometry": {
+                "heads": TPU_HEADS, "head_dim": 768 // TPU_HEADS,
+                "micro_batch": TPU_MB,
+                "tokens_per_sec_per_chip": round(tok_s2, 1),
+                "mfu": round(mfu2, 4),
+                "step_time_ms": round(step_ms2, 2),
+            },
             "platform": devs[0].platform,
             **({"backend_note": backend_err} if backend_err else {}),
         },

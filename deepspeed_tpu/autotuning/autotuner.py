@@ -311,9 +311,10 @@ class Autotuner:
             err = f"experiment process died (exit code {p.exitcode})"
         exp.metric_val = metric
         exp.error = err
-        if err and metric is None and "died" in (err or "") or \
-                (err and "timed out" in err):
-            # soft failures already logged by the child's own handler
+        if err and metric is None:
+            # log ALL failures from the parent (hard ones — died/timeout —
+            # and soft ones the child reported), so isolated-mode records
+            # match in-process mode
             logger.warning(
                 f"autotuning experiment {exp.name} failed: {err[:200]}")
 

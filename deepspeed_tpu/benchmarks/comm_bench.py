@@ -1,0 +1,120 @@
+"""``ds_bench`` console entry (reference ``bin/ds_bench`` -> the
+DeepSpeedExamples communication suite): sweep the core collectives over
+message sizes on the local mesh and print achieved algorithmic bandwidth.
+
+TPU-native form: collectives are ``jax.lax`` ops inside one jitted
+``shard_map`` per (op, size) over the data axis of the current mesh —
+the same lowering the training engine's gradient reduction uses, so the
+numbers are representative of ZeRO's communication path.  On a CPU host
+this runs against the virtual device mesh (correctness smoke); on a TPU
+slice it measures real ICI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _bench_collective(op: str, nbytes: int, mesh, axis: str, iters: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    world = mesh.shape[axis]
+    ln = max(nbytes // 4, world)   # per-shard buffer elements (= nbytes)
+    n = ln * world                 # global element count
+
+    # each step consumes the previous result (serial chain, no overlap)
+    # and restores the local input shape [ln] for the next iteration
+    if op == "allreduce":
+        def step(x):
+            return jax.lax.psum(x, axis) * (1.0 / world)
+    elif op == "allgather":
+        def step(x):
+            return jax.lax.all_gather(x, axis, tiled=True)[:ln]
+    elif op == "reducescatter":
+        def step(x):
+            y = jax.lax.psum_scatter(
+                jnp.concatenate([x] * world), axis, tiled=True)
+            return y * (1.0 / world)
+    elif op == "alltoall":
+        def step(x):
+            return jax.lax.all_to_all(
+                x.reshape(world, -1), axis, 0, 0, tiled=True).reshape(-1)
+    else:
+        raise ValueError(f"unknown op {op}")
+
+    spec = P(axis)
+
+    @jax.jit
+    def run(x):
+        def inner(x):
+            for _ in range(iters):
+                x = step(x)
+            return x
+        return shard_map(inner, mesh=mesh, in_specs=spec, out_specs=spec,
+                         check_rep=False)(x)
+
+    x = jax.device_put(jnp.ones((n,), jnp.float32),
+                       NamedSharding(mesh, spec))
+    jax.block_until_ready(run(x))
+    t0 = time.perf_counter()
+    out = run(x)
+    jax.device_get(jnp.ravel(out)[0])
+    dt = (time.perf_counter() - t0) / iters
+    return dt
+
+
+def main(args=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Collective communication micro-benchmark")
+    parser.add_argument("--ops", default="allreduce,allgather,"
+                        "reducescatter,alltoall")
+    parser.add_argument("--minsize", type=int, default=1 << 20,
+                        help="min message bytes (default 1MiB)")
+    parser.add_argument("--maxsize", type=int, default=1 << 28,
+                        help="max message bytes (default 256MiB)")
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--axis", default="data")
+    ns = parser.parse_args(args)
+
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.parallel import groups
+
+    comm.init_distributed()
+    if not groups.is_initialized():
+        groups.initialize_mesh()
+    mesh = groups.get_mesh()
+    axis = ns.axis
+    world = mesh.shape.get(axis, 1)
+    if world < 2:
+        # fold every axis into the benchmark axis if the chosen one is 1
+        for a, s in mesh.shape.items():
+            if s > 1:
+                axis, world = a, s
+                break
+    print(f"# mesh={dict(mesh.shape)} axis={axis!r} world={world}")
+    if world < 2:
+        print("single device: nothing to benchmark", file=sys.stderr)
+        return 1
+    print(f"{'op':<14}{'bytes':>12}{'time/op':>12}{'busbw GB/s':>12}")
+    size = ns.minsize
+    while size <= ns.maxsize:
+        for op in ns.ops.split(","):
+            dt = _bench_collective(op, size, mesh, axis, ns.iters)
+            # algorithmic -> bus bandwidth factors (ring algorithms)
+            factor = {"allreduce": 2 * (world - 1) / world,
+                      "allgather": (world - 1) / world,
+                      "reducescatter": (world - 1) / world,
+                      "alltoall": (world - 1) / world}[op]
+            bw = size * factor / dt / 1e9
+            print(f"{op:<14}{size:>12}{dt * 1e3:>10.3f}ms{bw:>12.2f}")
+        size *= 4
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,43 @@ def is_initialized() -> bool:
     return _initialized
 
 
+def _slurm_first_host(nodelist: str) -> str:
+    """First hostname of a SLURM nodelist — rank 0 under block
+    distribution.  Handles plain comma lists, `scontrol show hostnames`
+    when present, and the simple compressed ``prefix[NN-MM,...]`` form;
+    returns '' when the list cannot be resolved."""
+    if not nodelist:
+        return ""
+    # head element at the top level (commas inside [...] are range lists)
+    depth, head = 0, nodelist
+    for i, c in enumerate(nodelist):
+        if c == "[":
+            depth += 1
+        elif c == "]":
+            depth -= 1
+        elif c == "," and depth == 0:
+            head = nodelist[:i]
+            break
+    if "[" not in head:
+        return head
+    import re
+    import shutil
+    import subprocess
+
+    if shutil.which("scontrol"):
+        try:
+            r = subprocess.run(["scontrol", "show", "hostnames", nodelist],
+                               capture_output=True, text=True, timeout=10)
+            if r.returncode == 0 and r.stdout.strip():
+                return r.stdout.split()[0]
+        except Exception:  # noqa: BLE001 — fall through to the parser
+            pass
+    m = re.match(r"^([^,\[]+)\[([0-9]+)", head)
+    if m:
+        return f"{m.group(1)}{m.group(2)}"
+    return ""
+
+
 def mpi_discovery(distributed_port: int = 29500, verbose: bool = True
                   ) -> None:
     """Populate RANK/WORLD_SIZE/LOCAL_RANK from scheduler environments when
@@ -68,14 +105,18 @@ def mpi_discovery(distributed_port: int = 29500, verbose: bool = True
                 # rank 0's HOST, not the submitting node:
                 # SLURM_LAUNCH_NODE_IPADDR is where srun was typed (often
                 # a login node with no task). The first entry of the job
-                # nodelist is rank 0 under block distribution; compressed
-                # ranges (node[01-04]) can't be parsed without scontrol,
-                # so leave it unset and let init fail loudly rather than
-                # hang on a coordinator nobody can bind.
+                # nodelist is rank 0 under block distribution. Compressed
+                # ranges (node[01-04] — the common production form) are
+                # expanded via `scontrol show hostnames` when available,
+                # falling back to parsing the simple prefix[NN-MM] form;
+                # only if both fail is the address left unset so init
+                # fails loudly rather than hang on a coordinator nobody
+                # can bind.
                 nodelist = env.get("SLURM_JOB_NODELIST", "")
-                if nodelist and "[" not in nodelist:
+                host = _slurm_first_host(nodelist)
+                if host:
                     env["COORDINATOR_ADDRESS"] = \
-                        f"{nodelist.split(',')[0]}:{distributed_port}"
+                        f"{host}:{distributed_port}"
             if verbose:
                 logger.info(
                     f"mpi_discovery: rank={env['RANK']} "

@@ -52,14 +52,13 @@ def dropless_moe(x, moe_params, k: int, dtype, grouped=None):
     of E·T (4× fewer for Mixtral's 8-expert top-2).  ``grouped=False``
     forces the dense all-experts einsum (the parity oracle).
     """
-    from deepspeed_tpu.ops.grouped_gemm import grouped_moe_ffn
+    from deepspeed_tpu.ops.grouped_gemm import (exact_topk_routing,
+                                                grouped_moe_ffn)
 
     wg = moe_params["gate"]["wg"]["kernel"]            # [H, E]
     experts = moe_params["experts"]
     logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)   # [T, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    topv, topi = jax.lax.top_k(probs, k)               # [T, k]
-    w = topv / jnp.maximum(jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
+    topi, w = exact_topk_routing(logits, k)            # [T, k]
     e_count = wg.shape[1]
     w_gate = experts["w_gate"].astype(dtype)           # [E, H, F]
     w_up = experts["w_up"].astype(dtype)

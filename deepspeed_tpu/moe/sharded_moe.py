@@ -133,16 +133,16 @@ class TopKGate(nn.Module):
             # weights, NO capacity buckets (grouped GEMM handles the
             # ragged per-expert token counts).  Returns
             # (l_aux, topi [S,k], topw [S,k]).
+            from deepspeed_tpu.ops.grouped_gemm import exact_topk_routing
+
+            topi, topw = exact_topk_routing(logits, self.k)
             probs = jax.nn.softmax(logits, axis=-1)
-            topv, topi = jax.lax.top_k(probs, self.k)
-            topw = topv / jnp.maximum(
-                jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
             me = jnp.mean(probs, axis=0)
             ce = jnp.mean(
                 jnp.sum(jax.nn.one_hot(topi, self.num_experts), axis=1),
                 axis=0) / self.k
             l_aux = jnp.sum(me * ce) * self.num_experts
-            return l_aux, topi.astype(jnp.int32), topw
+            return l_aux, topi, topw
         cf = self.capacity_factor if train else self.eval_capacity_factor
         if self.k == 1:
             return top1gating(logits, cf, self.min_capacity,

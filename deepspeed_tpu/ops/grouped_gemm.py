@@ -319,11 +319,14 @@ def gmm(lhs: jnp.ndarray, rhs: jnp.ndarray, group_sizes: jnp.ndarray,
 
 
 def _use_kernel(interpret, m, n, tile_m, tile_n) -> Tuple[bool, bool]:
-    """(run kernel composition, interpret mode)"""
+    """(run kernel composition, interpret mode).  interpret=None (the
+    production default) runs the kernel on TPU only — on other backends
+    the XLA reference composition is far faster than Python-level
+    interpret-mode grid emulation; tests opt into interpret=True."""
     if m % tile_m != 0 or n % tile_n != 0:
         return False, False
     if interpret is None:
-        return True, not _on_tpu()
+        return (True, False) if _on_tpu() else (False, False)
     return True, bool(interpret)
 
 
@@ -393,6 +396,18 @@ def _pick_tiles(m_dim: int, k_dim: int, n_dim: int):
             if need <= _VMEM_BUDGET:
                 return tm, tn
     return 128, 128
+
+
+def exact_topk_routing(logits: jnp.ndarray, k: int):
+    """Dropless router: softmax -> top-k -> renormalised weights (HF
+    Mixtral semantics).  The single source of truth shared by the
+    training gate (moe/sharded_moe.py), the ragged inference path
+    (ragged_mixtral.py), and benchmarks.  Returns (topi [T,k] int32,
+    topw [T,k] fp32)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)
+    topw = topv / jnp.maximum(jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
+    return topi.astype(jnp.int32), topw
 
 
 # --------------------------------------------------------------------- #

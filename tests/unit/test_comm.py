@@ -116,3 +116,15 @@ def test_host_api():
     assert dist.get_rank() == 0
     assert dist.get_world_size() == 1
     dist.barrier()
+
+
+def test_slurm_first_host_compressed_nodelists():
+    """mpi_discovery must resolve rank-0's host from compressed SLURM
+    nodelists (ADVICE r3: node[01-04] is the common production form)."""
+    from deepspeed_tpu.comm.comm import _slurm_first_host
+
+    assert _slurm_first_host("node01,node02") == "node01"
+    assert _slurm_first_host("node[01-04]") == "node01"
+    assert _slurm_first_host("gpu[003,007-009]") == "gpu003"
+    assert _slurm_first_host("tpu-host[12-14],other[1-2]") == "tpu-host12"
+    assert _slurm_first_host("") == ""
