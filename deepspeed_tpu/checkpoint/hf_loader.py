@@ -209,6 +209,32 @@ def _opt_rules() -> List[Rule]:
     ]
 
 
+def _falcon_rules() -> List[Rule]:
+    def ln(m):
+        return ((f"h_{m.group(1)}", "input_layernorm",
+                 "scale" if m.group(2) == "weight" else "bias"), None)
+
+    def lin(m):
+        name = "kernel" if m.group(4) == "weight" else "bias"
+        return ((f"h_{m.group(1)}", m.group(2), m.group(3), name),
+                "t" if name == "kernel" else None)
+
+    return [
+        (r"^(transformer\.)?word_embeddings\.weight$",
+         lambda m: (("word_embeddings", "embedding"), None)),
+        (r"^(?:transformer\.)?h\.(\d+)\.input_layernorm\.(weight|bias)$",
+         ln),
+        (r"^(?:transformer\.)?h\.(\d+)\.(self_attention)\."
+         r"(query_key_value|dense)\.(weight|bias)$", lin),
+        (r"^(?:transformer\.)?h\.(\d+)\.(mlp)\."
+         r"(dense_h_to_4h|dense_4h_to_h)\.(weight|bias)$", lin),
+        (r"^(transformer\.)?ln_f\.(weight|bias)$",
+         lambda m: (("ln_f",
+                     "scale" if m.group(2) == "weight" else "bias"), None)),
+        (r"^lm_head\.weight$", lambda m: (None, None)),  # tied
+    ]
+
+
 _ARCH_RULES: Dict[str, Callable[[], List[Rule]]] = {
     "llama": _llama_rules,
     "mistral": _llama_rules,     # same architecture/serialization
@@ -216,6 +242,7 @@ _ARCH_RULES: Dict[str, Callable[[], List[Rule]]] = {
     "mixtral": _mixtral_rules,
     "gpt2": _gpt2_rules,
     "opt": _opt_rules,
+    "falcon": _falcon_rules,
 }
 
 
@@ -291,6 +318,34 @@ def config_from_hf(model_path: str, dtype: Any = None):
             num_attention_heads=cfg["num_attention_heads"],
             max_position_embeddings=cfg["max_position_embeddings"],
             do_layer_norm_before=cfg.get("do_layer_norm_before", True),
+            dtype=dt)
+    if arch == "falcon":
+        from deepspeed_tpu.models.falcon import FalconConfig
+
+        if not cfg.get("parallel_attn", True):
+            raise HFLoadError(
+                "only parallel-attention Falcon variants are supported "
+                "(as in the reference, falcon/model.py:132)")
+        if cfg.get("alibi", False):
+            raise HFLoadError(
+                "alibi Falcon variants are not supported — the models "
+                "here apply rotary embeddings")
+        if cfg.get("new_decoder_architecture", False):
+            raise HFLoadError(
+                "Falcon new_decoder_architecture (dual ln_attn/ln_mlp "
+                "norms, 40B/180B) is not supported yet; the 7B-style "
+                "parallel-attention layout is")
+        kv = 1 if cfg.get("multi_query", True) else \
+            cfg["num_attention_heads"]
+        return arch, FalconConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            num_hidden_layers=cfg["num_hidden_layers"],
+            num_attention_heads=cfg["num_attention_heads"],
+            num_kv_heads=kv,
+            layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-5),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            bias=cfg.get("bias", False),
             dtype=dt)
     raise HFLoadError(f"unsupported model_type {arch!r} in {model_path}")
 
@@ -421,4 +476,8 @@ def model_from_hf(model_path: str, dtype: Any = None):
         from deepspeed_tpu.models.opt import OPTForCausalLM
 
         return arch, cfg, OPTForCausalLM(cfg)
+    if arch == "falcon":
+        from deepspeed_tpu.models.falcon import FalconForCausalLM
+
+        return arch, cfg, FalconForCausalLM(cfg)
     raise HFLoadError(f"no model class for architecture {arch!r}")

@@ -616,6 +616,16 @@ class InferenceEngineV2:
         block_size = cfg.kv_cache.block_size
         if arch in ("llama", "mistral", "internlm"):
             model = RaggedLlama(mcfg, block_size, mesh=mesh)
+        elif arch in ("opt", "falcon"):
+            from deepspeed_tpu.inference.v2.model_implementations import (
+                RaggedFalcon, RaggedOPT)
+
+            if mesh is not None and mesh.shape.get("model", 1) > 1:
+                raise ValueError(
+                    f"Ragged{arch.upper()} does not support tensor "
+                    f"parallelism yet — pass mesh=None")
+            cls_ = RaggedOPT if arch == "opt" else RaggedFalcon
+            model = cls_(mcfg, block_size)
         elif arch == "mixtral":
             from deepspeed_tpu.inference.v2.model_implementations. \
                 ragged_mixtral import RaggedMixtral

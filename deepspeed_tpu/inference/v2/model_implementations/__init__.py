@@ -7,8 +7,14 @@ from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
     ragged_param_specs,
     shard_ragged_params,
 )
+from deepspeed_tpu.inference.v2.model_implementations.ragged_falcon import (
+    RaggedFalcon,
+)
 from deepspeed_tpu.inference.v2.model_implementations.ragged_mixtral import (
     RaggedMixtral,
+)
+from deepspeed_tpu.inference.v2.model_implementations.ragged_opt import (
+    RaggedOPT,
 )
 
 # Mistral is the Llama architecture + sliding window: serve it with
@@ -16,5 +22,5 @@ from deepspeed_tpu.inference.v2.model_implementations.ragged_mixtral import (
 # mistral/ container reuses the llama modules the same way)
 RaggedMistral = RaggedLlama
 
-__all__ = ["RaggedLlama", "RaggedMistral", "RaggedMixtral",
-           "ragged_param_specs", "shard_ragged_params"]
+__all__ = ["RaggedLlama", "RaggedMistral", "RaggedMixtral", "RaggedOPT",
+           "RaggedFalcon", "ragged_param_specs", "shard_ragged_params"]

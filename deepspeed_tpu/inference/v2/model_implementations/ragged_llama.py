@@ -77,6 +77,15 @@ def shard_ragged_params(params, mesh: Mesh) -> Any:
 KV_SPEC = P(None, "model", None)  # pool [flat, Hkv, D]: kv heads split
 
 
+def _layer_norm(x, p, eps):
+    """Param-dict LayerNorm for ragged models (OPT/Falcon/GPT-style) —
+    delegates to the single fp32-upcast implementation in
+    ops/transformer.py."""
+    from deepspeed_tpu.ops.transformer import layer_norm
+
+    return layer_norm(x, p["scale"], p["bias"], eps)
+
+
 def _rms_norm(x, scale, eps):
     x32 = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)

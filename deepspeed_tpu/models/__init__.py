@@ -19,3 +19,6 @@ from deepspeed_tpu.models.opt import OPTConfig, OPTForCausalLM
 
 __all__ += ["MistralConfig", "MistralForCausalLM", "mistral_tiny",
             "OPTConfig", "OPTForCausalLM"]
+from deepspeed_tpu.models.falcon import FalconConfig, FalconForCausalLM
+
+__all__ += ["FalconConfig", "FalconForCausalLM"]

@@ -50,6 +50,10 @@ register_policy("megatron", POLICY_REGISTRY["gpt2"])
 
 register_policy("opt", OPT_PARTITION_RULES)
 
+from deepspeed_tpu.models.falcon import FALCON_PARTITION_RULES  # noqa: E402
+
+register_policy("falcon", FALCON_PARTITION_RULES)
+
 register_policy("bloom", [
     (r"word_embeddings/embedding", P("model", None)),
     (r"query_key_value/kernel", P(None, "model")),
