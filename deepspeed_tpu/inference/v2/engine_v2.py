@@ -80,6 +80,12 @@ class InferenceEngineV2:
         self.config = config or RaggedInferenceEngineConfig()
         sm_cfg = self.config.state_manager
         kv_cfg = self.config.kv_cache
+        max_pos = getattr(model, "max_positions", None)
+        if max_pos is not None and sm_cfg.max_context > max_pos:
+            raise ValueError(
+                f"state_manager.max_context={sm_cfg.max_context} exceeds "
+                f"the model's learned position table ({max_pos}); "
+                f"positions past it would silently alias the last row")
         self.model = model
         self.params = params
         self.state_manager = DSStateManager(

@@ -49,6 +49,13 @@ class RaggedOPT:
     def head_dim(self):
         return self.config.head_dim
 
+    @property
+    def max_positions(self):
+        """Learned position table size — the engine validates its
+        max_context against this (positions past the table would
+        silently alias the last row otherwise)."""
+        return self.config.max_position_embeddings
+
     def __call__(self, params: Dict[str, Any], kv_cache: Dict[str, Any],
                  batch: Dict[str, jax.Array], prefill_tile=None,
                  decode=False):

@@ -237,11 +237,6 @@ class DeepSpeedEngine:
                 raise ValueError(
                     "offload_param requires ZeRO stage 3 (reference "
                     "constraint: only stage 3 partitions parameters)")
-            if self._offload_param_device == "nvme":
-                raise NotImplementedError(
-                    "offload_param to NVMe is not implemented yet — "
-                    "host ('cpu') param offload is; NVMe currently covers "
-                    "optimizer state (offload_optimizer.device='nvme')")
         self._param_offload_plan = None  # built with the shardings
         self._params_on_host = False
         self.base_param_specs = base_param_specs
@@ -452,10 +447,15 @@ class DeepSpeedEngine:
 
             self._param_offload_plan = OffloadPlan(
                 params_shapes, ratio=1.0,
-                device=self._offload_param_device)
+                device=self._offload_param_device,
+                nvme_path=self.config.zero_config.offload_param.nvme_path
+                if self._offload_param_device == "nvme" else None)
             log_dist(
-                "ZeRO-Infinity: compute params host-resident between "
-                "steps (offload_param.device="
+                "ZeRO-Infinity: compute params "
+                + ("on NVMe swap files (pipelined AIO prefetch)"
+                   if self._offload_param_device == "nvme"
+                   else "host-resident")
+                + " between steps (offload_param.device="
                 f"{self._offload_param_device})", ranks=[0])
         return self._shardings
 

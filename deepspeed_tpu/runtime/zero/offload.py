@@ -100,6 +100,11 @@ class OffloadPlan:
         """
         if self.device == "nvme" and to_host:
             return self._swap_out(tree, swap_prefix)
+        if self.device == "nvme" and not to_host:
+            # pipelined AIO restore: read leaf k+1 from NVMe while leaf k
+            # streams to HBM; host RSS bounded by the leaves in flight
+            return self._swapper.swap_in_tree_to_device(
+                swap_prefix, tree, device_shardings, mask=self.mask)
         shardings = self.host_shardings(device_shardings) if to_host \
             else device_shardings
 

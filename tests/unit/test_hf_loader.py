@@ -318,3 +318,26 @@ def test_v1_engine_generate_from_hf(tmp_path):
             torch.from_numpy(ids), max_new_tokens=8, do_sample=False,
             pad_token_id=0).numpy()
     np.testing.assert_array_equal(ours[:, :theirs.shape[1]], theirs)
+
+
+def test_v2_opt_rejects_context_past_position_table(tmp_path):
+    """OPT's learned position table bounds max_context — exceeding it
+    must fail at engine construction, not silently alias positions."""
+    hf_cfg = transformers.OPTConfig(
+        vocab_size=256, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=16,
+        do_layer_norm_before=True, word_embed_proj_dim=64)
+    hf = transformers.OPTForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+
+    eng_cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": 16,
+                          "max_ragged_sequence_count": 2,
+                          "max_context": 32},  # > 16-position table
+        "kv_cache": {"block_size": 8},
+    })
+    with pytest.raises(ValueError, match="position table"):
+        InferenceEngineV2.from_hf(path, eng_cfg, dtype=jnp.float32)

@@ -170,3 +170,95 @@ def test_offload_param_requires_stage3():
     }
     with pytest.raises(ValueError, match="stage 3"):
         _engine(cfg)
+
+
+# ------------------------------------------------------------------ #
+# ZeRO-Infinity param tier: offload_param.device='nvme' (reference
+# runtime/swap_tensor/partitioned_param_swapper.py:36)
+# ------------------------------------------------------------------ #
+def _param_cfg(device, path=None):
+    cfg = _config(zero_stage=3)
+    blk = {"device": device}
+    if path is not None:
+        blk["nvme_path"] = str(path)
+    cfg["zero_optimization"]["offload_param"] = blk
+    return cfg
+
+
+def test_nvme_param_offload_matches_no_offload(tmp_path):
+    """Params living in NVMe swap files between steps (pipelined AIO
+    restore each forward) must train identically to no offload."""
+    ref = _engine(_config(zero_stage=3))
+    off = _engine(_param_cfg("nvme", tmp_path))
+    l_ref = train_steps(ref, steps=4, batch=16, hidden_dim=HIDDEN)
+    l_off = train_steps(off, steps=4, batch=16, hidden_dim=HIDDEN)
+    np.testing.assert_allclose(l_off, l_ref, rtol=1e-6)
+    # swap files exist on "NVMe"
+    import os
+    swp = [f for _r, _d, fs in os.walk(tmp_path) for f in fs
+           if f.endswith(".swp")]
+    assert swp, "no swap files written under nvme_path"
+
+
+def test_nvme_param_offload_host_leaves_are_memmaps(tmp_path):
+    """Between steps the swapped params are read-only memmaps (evictable
+    page cache), not RAM arrays."""
+    eng = _engine(_param_cfg("nvme", tmp_path))
+    train_steps(eng, steps=2, batch=16, hidden_dim=HIDDEN)
+    # epilogue leaves params on the nvme tier
+    leaves = jax.tree.leaves(eng.state["params"])
+    assert all(isinstance(l, np.memmap) for l in leaves), \
+        [type(l) for l in leaves]
+
+
+def test_nvme_param_offload_requires_path():
+    with pytest.raises(ValueError, match="nvme_path"):
+        _engine(_param_cfg("nvme"))
+
+
+def test_nvme_swapper_rss_bounded(tmp_path):
+    """Swapping out a tree must not leave its bytes RAM-resident, and the
+    pipelined device restore must hold at most ~two leaves in flight —
+    host RSS stays well below total tree bytes (the point of the
+    ZeRO-Infinity param tier)."""
+    import gc
+    import os
+
+    from deepspeed_tpu.runtime.swap_tensor import PartitionedOptimizerSwapper
+
+    def rss_bytes():
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+
+    sw = PartitionedOptimizerSwapper(str(tmp_path))
+    # leaves > glibc's max dynamic mmap threshold (32MB) so freed numpy
+    # buffers are returned to the OS and RSS actually reflects residency
+    n_leaves, leaf_bytes = 4, 40 * 1024 * 1024
+    total = n_leaves * leaf_bytes
+
+    def make(i):
+        # float32: jax (x64 disabled) would silently downcast float64
+        # leaves at device_put, breaking exact comparison
+        return np.random.default_rng(i).standard_normal(
+            (leaf_bytes // 4,)).astype(np.float32)
+
+    gc.collect()
+    base = rss_bytes()
+    tree = {f"p{i}": make(i) for i in range(n_leaves)}
+    swapped = sw.swap_out_tree("params", tree)
+    del tree
+    gc.collect()
+    after = rss_bytes() - base
+    # the 160MB tree is gone from RAM (memmaps are not resident until
+    # touched); allow generous slack for allocator noise
+    assert after < total // 2, \
+        f"RSS grew {after/1e6:.0f}MB for a {total/1e6:.0f}MB tree"
+    # restore through the pipelined path and verify content parity
+    import jax as _jax
+
+    sh = jax.tree.map(
+        lambda _l: _jax.sharding.SingleDeviceSharding(_jax.devices()[0]),
+        swapped)
+    back = sw.swap_in_tree_to_device("params", swapped, sh)
+    for i in range(n_leaves):
+        np.testing.assert_array_equal(np.asarray(back[f"p{i}"]), make(i))
