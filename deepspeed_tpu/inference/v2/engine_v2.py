@@ -603,13 +603,20 @@ class InferenceEngineV2:
     @classmethod
     def from_hf(cls, model_path: str,
                 config: Optional[RaggedInferenceEngineConfig] = None,
-                mesh=None, dtype=None):
+                mesh=None, dtype=None, quantize_bits: Optional[int] = None,
+                quantize_groups: int = 64):
         """Serve a real HuggingFace checkpoint directory (reference: the
         MII/engine_factory path that builds a FastGen engine from a HF
         snapshot).  Llama/Mistral/Mixtral-family checkpoints supported;
         with ``mesh`` (a non-trivial 'model' axis) weights land
         PRE-SHARDED by the Megatron split rules via
         :func:`shard_ragged_params`'s specs — no full host/device copy.
+
+        ``quantize_bits=8``: weight-only quantized serving (reference
+        ★cutlass_ops/mixed_gemm) — projection weights rest AND stream as
+        int8 (embeddings excepted); the serving matmuls dequantize tiles
+        in VMEM via ops/quantized_matmul.py, halving decode weight
+        bandwidth and HBM footprint.
         """
         import jax.numpy as jnp
 
@@ -649,6 +656,24 @@ class InferenceEngineV2:
             model_path, dtype=dtype or jnp.bfloat16,
             mesh=mesh if (mesh is not None
                           and getattr(model, "tp", 1) > 1) else None)
+        if quantize_bits:
+            if arch not in ("llama", "mistral", "internlm"):
+                raise ValueError(
+                    f"weight-quantized serving covers the Llama-family "
+                    f"ragged models; {arch!r} still consumes plain "
+                    f"kernels")
+            if getattr(model, "tp", 1) > 1:
+                raise ValueError(
+                    "weight-quantized serving does not compose with "
+                    "tensor parallelism in the v2 engine yet")
+            from deepspeed_tpu.runtime.weight_quantizer import (
+                WeightQuantization)
+
+            wq = WeightQuantization(quantize_bits=quantize_bits,
+                                    quantize_groups=quantize_groups)
+            params, n = wq.model_quantize(params, exclude=("embed",))
+            log_dist(f"InferenceEngineV2: int{quantize_bits} weight-only "
+                     f"quantization on {n} matrices", ranks=[0])
         return cls(model, params, cfg)
 
     @classmethod

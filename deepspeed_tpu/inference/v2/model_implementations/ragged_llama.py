@@ -52,6 +52,7 @@ from deepspeed_tpu.models.llama import LlamaConfig, apply_rotary
 # inference/v2/model_implementations/sharding/*.py) — serving shares the
 # training rules so a sharding change propagates to both
 from deepspeed_tpu.models.llama import LLAMA_PARTITION_RULES as _TP_RULES
+from deepspeed_tpu.ops.quantized_matmul import qmm
 
 
 def ragged_param_specs(params) -> Any:
@@ -252,9 +253,9 @@ def ragged_attention_block(lp_attn, xa, layer_cache, batch, block_size, cfg,
     Returns ``(attn_out [T, H_model], new_layer_cache)``."""
     dt = cfg.dtype
     kv_dest = batch["kv_dest"]
-    q = (xa @ lp_attn["q_proj"]["kernel"].astype(dt)).reshape(-1, h, d)
-    k = (xa @ lp_attn["k_proj"]["kernel"].astype(dt)).reshape(-1, hkv, d)
-    v = (xa @ lp_attn["v_proj"]["kernel"].astype(dt)).reshape(-1, hkv, d)
+    q = qmm(xa, lp_attn["q_proj"]["kernel"], dt).reshape(-1, h, d)
+    k = qmm(xa, lp_attn["k_proj"]["kernel"], dt).reshape(-1, hkv, d)
+    v = qmm(xa, lp_attn["v_proj"]["kernel"], dt).reshape(-1, hkv, d)
     # apply_rotary broadcasts over [T, H, D] with cos/sin [T, 1, D/2]
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
@@ -264,7 +265,7 @@ def ragged_attention_block(lp_attn, xa, layer_cache, batch, block_size, cfg,
                            window=cfg.sliding_window,
                            prefill_tile=prefill_tile,
                            decode_mode=decode_mode)
-    out = out.reshape(-1, h * d) @ lp_attn["o_proj"]["kernel"].astype(dt)
+    out = qmm(out.reshape(-1, h * d), lp_attn["o_proj"]["kernel"], dt)
     if ax is not None:
         out = jax.lax.psum(out, ax)                   # row-parallel attn-out
     return out, {"k": k_pool, "v": v_pool}
@@ -384,9 +385,10 @@ class RaggedLlama:
             x = x + out
             xm = _rms_norm(x, lp["post_attention_layernorm"]["scale"],
                            cfg.rms_norm_eps)
-            gate = xm @ mlp["gate_proj"]["kernel"].astype(dt)
-            up = xm @ mlp["up_proj"]["kernel"].astype(dt)
-            mo = (jax.nn.silu(gate) * up) @ mlp["down_proj"]["kernel"].astype(dt)
+            gate = qmm(xm, mlp["gate_proj"]["kernel"], dt)
+            up = qmm(xm, mlp["up_proj"]["kernel"], dt)
+            mo = qmm(jax.nn.silu(gate) * up, mlp["down_proj"]["kernel"],
+                     dt)
             if ax is not None:
                 mo = jax.lax.psum(mo, ax)         # row-parallel mlp-down
             x = x + mo
@@ -395,7 +397,7 @@ class RaggedLlama:
             logits = x @ m["embed_tokens"]["embedding"].astype(dt).T
             # tied unembed against the vocab-split table: gather below
         else:
-            logits = x @ params["lm_head"]["kernel"].astype(dt)
+            logits = qmm(x, params["lm_head"]["kernel"], dt)
         # ★logits_gather analog: slice each slot's last token FIRST, then
         # (TP) all-gather only the [S, V/tp] slice (reference
         # sharding/unembed.py gathers the sliced logits too)

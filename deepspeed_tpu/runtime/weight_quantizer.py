@@ -77,45 +77,47 @@ class WeightQuantization:
         return {"q": q.reshape(w.shape), "scale": scale}
 
     def model_quantize(self, params: Any,
-                       min_size: int = MIN_SIZE_DEFAULT
+                       min_size: int = MIN_SIZE_DEFAULT,
+                       exclude: Tuple[str, ...] = ()
                        ) -> Tuple[Any, int]:
         """Quantize every matrix leaf with >= min_size elements. Returns
-        (tree with {q, scale} records, count quantized)."""
+        (tree with {q, scale} records, count quantized).  Leaves whose
+        '/'-joined path contains any ``exclude`` substring stay
+        full-precision (serving excludes embedding tables: a lookup
+        touches a handful of rows, so dequantizing the table would cost
+        more than it saves)."""
         count = 0
 
         def one(path, leaf):
             nonlocal count
-            if not self.should_quantize(leaf, min_size):
+            name = self.leaf_name(path)
+            if not self.should_quantize(leaf, min_size) or \
+                    any(e in name for e in exclude):
                 return leaf
             count += 1
-            return self.quantize_leaf(leaf,
-                                      self.groups_for(self.leaf_name(path)))
+            return self.quantize_leaf(jnp.asarray(leaf),
+                                      self.groups_for(name))
 
         out = jax.tree_util.tree_map_with_path(one, params)
         return out, count
 
     @staticmethod
     def is_quantized_record(leaf) -> bool:
-        # key set AND int8 payload: a model's own {'q','scale'} param
-        # subtree (fp32 weights) must not be mistaken for a record
-        return (isinstance(leaf, dict) and set(leaf) == {"q", "scale"}
-                and getattr(leaf["q"], "dtype", None) == jnp.int8)
+        from deepspeed_tpu.ops.quantized_matmul import is_quant_record
+
+        return is_quant_record(leaf)
 
     def dequantize_tree(self, tree: Any, dtype=jnp.bfloat16) -> Any:
+        """Restore compute-precision weights (split ONLY dim 0 into
+        groups and broadcast the scale — trailing dims untouched, so a
+        TP-sharded record dequantizes with zero resharding under GSPMD:
+        column shards see a replicated scale; row shards own whole
+        groups)."""
+        from deepspeed_tpu.ops.quantized_matmul import dequant_reference
+
         def one(leaf):
             if self.is_quantized_record(leaf):
-                q, scale = leaf["q"], leaf["scale"]
-                shape = q.shape
-                g = scale.shape[0]
-                # split ONLY dim 0 into (groups, rows/groups) and broadcast
-                # the scale — trailing dims are untouched, so a TP-sharded
-                # record dequantizes with zero resharding under GSPMD
-                # (column shards see a replicated scale; row shards own
-                # whole groups)
-                q3 = q.reshape((g, shape[0] // g) + shape[1:])
-                exp = scale.reshape((g,) + (1,) * (q3.ndim - 1))
-                return (q3.astype(jnp.float32) * exp).astype(dtype) \
-                    .reshape(shape)
+                return dequant_reference(leaf, dtype)
             return leaf
 
         return jax.tree.map(one, tree,

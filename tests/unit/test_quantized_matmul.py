@@ -1,0 +1,120 @@
+"""Weight-quantized serving matmul (ops/quantized_matmul.py) vs the
+grouped-dequant composition — the reference-kernel test pattern (Pallas
+kernel in interpret mode vs jnp oracle), plus the serving integration:
+int8-resident params through the FastGen engine.
+
+Reference analog: inference/v2/kernels/cutlass_ops/mixed_gemm/.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantized_matmul import (
+    dequant_reference,
+    qmm,
+    quantized_matmul,
+)
+from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+
+
+def _record(k, n, groups, seed=0):
+    rng = np.random.default_rng(seed)
+    w = jnp.asarray(rng.standard_normal((k, n)) * 0.1, jnp.float32)
+    wq = WeightQuantization(quantize_bits=8, quantize_groups=groups)
+    return w, wq.quantize_leaf(w, groups)
+
+
+@pytest.mark.parametrize("k,n,groups,m", [
+    (256, 512, 4, 16),     # tile_k spans multiple groups
+    (256, 512, 32, 16),    # rows_per_group 8
+    (512, 256, 8, 5),      # M needs sublane padding
+    (128, 256, 1, 16),     # single group
+])
+def test_quantized_matmul_kernel_parity(k, n, groups, m):
+    w, rec = _record(k, n, groups)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((m, k)) * 0.1,
+                    jnp.float32)
+    got = quantized_matmul(x, rec, tile_n=128, interpret=True)
+    want = x @ dequant_reference(rec, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_quantized_matmul_quantization_error_bounded():
+    """End-to-end int8 error vs the ORIGINAL weight stays at the groupwise
+    quantization level (sanity that scales are applied right)."""
+    w, rec = _record(512, 256, 16, seed=3)
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((8, 512)) * 0.1,
+                    jnp.float32)
+    got = quantized_matmul(x, rec, tile_n=128, interpret=True)
+    exact = x @ w
+    err = np.abs(np.asarray(got) - np.asarray(exact))
+    rel = err.max() / np.abs(np.asarray(exact)).max()
+    assert rel < 0.05, rel
+
+
+def test_qmm_dispatch():
+    w, rec = _record(128, 256, 4)
+    x = jnp.ones((4, 128), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(qmm(x, w, jnp.float32)), np.asarray(x @ w), rtol=1e-6)
+    got = qmm(x, rec)   # record path (XLA fallback off-TPU)
+    want = x @ dequant_reference(rec, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_v2_engine_quantized_serving(tmp_path):
+    """from_hf(quantize_bits=8): projection weights REST as int8 (the
+    HBM-footprint claim — tree bytes drop ~2x) and generation stays
+    close to the full-precision engine."""
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    torch.manual_seed(0)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    hf_cfg.save_pretrained(tmp_path)
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+
+    eng_cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": 16,
+                          "max_ragged_sequence_count": 2,
+                          "max_context": 32},
+        "kv_cache": {"block_size": 8},
+    })
+    full = InferenceEngineV2.from_hf(str(tmp_path), eng_cfg,
+                                     dtype=jnp.float32)
+    quant = InferenceEngineV2.from_hf(str(tmp_path), eng_cfg,
+                                      dtype=jnp.float32, quantize_bits=8,
+                                      quantize_groups=8)
+
+    def tree_bytes(t):
+        return sum(l.nbytes for l in jax.tree_util.tree_leaves(t))
+
+    assert tree_bytes(quant.params) < 0.62 * tree_bytes(full.params)
+    # every projection matrix is int8 at rest; embeddings full precision
+    q_leaf = quant.params["model"]["layers_0"]["self_attn"]["q_proj"][
+        "kernel"]
+    assert q_leaf["q"].dtype == jnp.int8
+    emb = quant.params["model"]["embed_tokens"]["embedding"]
+    assert emb.dtype == jnp.float32
+
+    ids = np.random.default_rng(5).integers(0, 256, size=(1, 8),
+                                            dtype=np.int64)
+    lf = full.put([1], [ids[0].tolist()])
+    lq = quant.put([1], [ids[0].tolist()])
+    full.flush([1])
+    quant.flush([1])
+    # int8 groupwise error bound, not exactness
+    denom = np.abs(lf[1]).max()
+    assert np.abs(lf[1] - lq[1]).max() / denom < 0.08
