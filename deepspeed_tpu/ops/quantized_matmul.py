@@ -29,12 +29,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:  # noqa: BLE001
-        return False
+from deepspeed_tpu.ops.flash_attention import _on_tpu
 
 
 def is_quant_record(leaf) -> bool:
@@ -110,15 +105,19 @@ def _qmm_call(x, q, scale, tile_k: int, tile_n: int, interpret: bool):
 
 
 def dequant_reference(record, dtype=jnp.bfloat16):
-    """Grouped dequant (the in-graph composition; also the test oracle)
-    via the single kernel-layer implementation in ops/quantizer.py."""
-    from deepspeed_tpu.ops.quantizer import dequantize
+    """Grouped dequant — THE single in-graph composition (also the test
+    oracle; ``WeightQuantization.dequantize_tree`` delegates here).
 
+    Splits ONLY dim 0 into (groups, rows/groups) and broadcasts the
+    scale — trailing dims are untouched, so a dim-1 (column/TP) sharded
+    record dequantizes with ZERO resharding under GSPMD (column shards
+    see a replicated scale; row shards own whole groups)."""
     q, scale = record["q"], record["scale"]
     shape = q.shape
     g = scale.shape[0]
-    return dequantize(q.reshape(g, -1), scale,
-                      dtype=dtype).reshape(shape)
+    q3 = q.reshape((g, shape[0] // g) + shape[1:])
+    exp = scale.reshape((g,) + (1,) * (q3.ndim - 1))
+    return (q3.astype(jnp.float32) * exp).astype(dtype).reshape(shape)
 
 
 def quantized_matmul(x: jnp.ndarray, record, tile_n: int = 256,
