@@ -36,7 +36,8 @@ def initialize(args=None,
                loss_fn: Optional[Callable] = None,
                topology: Optional[MeshTopology] = None,
                base_param_specs: Any = None,
-               batch_spec: Any = None) -> Tuple:
+               batch_spec: Any = None,
+               **engine_kwargs) -> Tuple:
     """Build the training engine (reference: deepspeed/__init__.py:64).
 
     Returns ``(engine, optimizer, dataloader, lr_scheduler)`` exactly like the
@@ -77,7 +78,8 @@ def initialize(args=None,
                                 loss_fn=loss_fn, topology=topology,
                                 base_param_specs=base_param_specs,
                                 batch_spec=batch_spec,
-                                lr_scheduler=lr_scheduler)
+                                lr_scheduler=lr_scheduler,
+                                **engine_kwargs)
     elif _hybrid_enabled(cfg):
         from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
 
@@ -86,14 +88,16 @@ def initialize(args=None,
                                        loss_fn=loss_fn, topology=topology,
                                        base_param_specs=base_param_specs,
                                        batch_spec=batch_spec,
-                                       lr_scheduler=lr_scheduler)
+                                       lr_scheduler=lr_scheduler,
+                                       **engine_kwargs)
     else:
         engine = DeepSpeedEngine(model=model, config=cfg,
                                  model_parameters=model_parameters,
                                  loss_fn=loss_fn, topology=topology,
                                  base_param_specs=base_param_specs,
                                  batch_spec=batch_spec,
-                                 lr_scheduler=lr_scheduler)
+                                 lr_scheduler=lr_scheduler,
+                                 **engine_kwargs)
 
     dataloader = None
     if training_data is not None:

@@ -61,8 +61,7 @@ def evoformer_attention_dense(Q, K, V, biases=None):
 # Pallas blockwise kernel
 # --------------------------------------------------------------------- #
 def _evo_kernel(q_ref, k_ref, v_ref, *rest, num_biases: int,
-                block_q: int, block_k: int, num_k_blocks: int,
-                scale: float):
+                num_k_blocks: int, scale: float):
     bias_refs = rest[:num_biases]
     o_ref = rest[num_biases]
     acc_ref, m_ref, l_ref = rest[num_biases + 1:]
@@ -105,8 +104,8 @@ def _evo_kernel(q_ref, k_ref, v_ref, *rest, num_biases: int,
 
 
 def _canon_bias(b, lead: Tuple[int, ...], h: int, sq: int, sk: int):
-    """Left-pad a bias to rank len(lead)+3 and return (array, dims) where
-    dims are its (possibly 1) sizes — no broadcast materialisation."""
+    """Left-pad a bias to rank len(lead)+3 (each dim full-size or 1) and
+    validate broadcastability — no broadcast materialisation."""
     want = len(lead) + 3
     if b.ndim < want:
         b = b.reshape((1,) * (want - b.ndim) + b.shape)
@@ -185,8 +184,7 @@ def _evo_kernel_call(q, k, v, biases, lead: Tuple[int, ...],
         ops.append(bflat)
 
     kernel = functools.partial(
-        _evo_kernel, num_biases=len(biases), block_q=block_q,
-        block_k=block_k, num_k_blocks=nk, scale=scale)
+        _evo_kernel, num_biases=len(biases), num_k_blocks=nk, scale=scale)
     return pl.pallas_call(
         kernel,
         grid=(L, h, nq, nk),
