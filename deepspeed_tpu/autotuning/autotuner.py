@@ -99,7 +99,8 @@ class Autotuner:
                  activation_bytes_per_sample: Optional[float] = None,
                  peak_flops: float = 2e14, peak_bw: float = 8e11,
                  isolate: bool = False, trial_timeout: float = 600.0,
-                 seed: int = 0):
+                 seed: int = 0,
+                 flops_per_sample: Optional[float] = None):
         """``sample_batch_fn(micro_batch)`` returns the engine-call args
         for one micro batch of that size (the model-info profile run uses
         size 1).
@@ -130,6 +131,9 @@ class Autotuner:
         self.activation_bytes_per_sample = activation_bytes_per_sample
         self.peak_flops = peak_flops  # roofline peaks for fast mode
         self.peak_bw = peak_bw
+        #: model flops per sample (e.g. FlopsProfiler.get_total_flops /
+        #: batch) — gives the model-based tuner a roofline prior
+        self.flops_per_sample = flops_per_sample
         self.isolate = isolate
         self.trial_timeout = trial_timeout
         self.rng = np.random.default_rng(seed)
@@ -183,17 +187,34 @@ class Autotuner:
         return need < self.hbm_bytes
 
     # -------------------------------------------------------------- #
-    def _candidates(self) -> List[Dict[str, Any]]:
-        space = [{"zero_stage": s, "micro_batch": m}
-                 for s, m in itertools.product(self.zero_stages,
-                                               self.micro_batch_sizes)]
-        if self.tuner_type == "random":
-            self.rng.shuffle(space)
-        elif self.tuner_type == "model_based":
-            # cheapest-memory-first so early trials establish a baseline
-            space.sort(key=lambda c: self.estimate_state_bytes(
-                c["zero_stage"], self._world()))
-        return space[:self.max_trials]
+    def search_space(self) -> List[Dict[str, Any]]:
+        return [{"zero_stage": s, "micro_batch": m}
+                for s, m in itertools.product(self.zero_stages,
+                                              self.micro_batch_sizes)]
+
+    def candidate_features(self, cand: Dict[str, Any]):
+        """Surrogate features for the model-based tuner: micro-batch
+        terms, ZeRO stage, the memory model's state bytes, and (when
+        the roofline peaks are known) a flops-derived throughput
+        prediction — the per-module flops profiler's totals feed this
+        through ``flops_per_sample``."""
+        world = self._world()
+        mb = float(cand["micro_batch"])
+        feats = [mb, np.log2(mb), float(cand["zero_stage"]),
+                 self.estimate_state_bytes(cand["zero_stage"], world)
+                 / 1e9]
+        if self.peak_flops and self.flops_per_sample:
+            # predicted compute time per step (ms): grows with the micro
+            # batch — the roofline signal the surrogate regresses against
+            feats.append(self.flops_per_sample * mb / self.peak_flops
+                         * 1e3)
+        return feats
+
+    def make_tuner(self):
+        from deepspeed_tpu.autotuning.tuner import make_tuner
+
+        return make_tuner(self.tuner_type, self.search_space(), self.rng,
+                          features_fn=self.candidate_features)
 
     def _world(self) -> int:
         from deepspeed_tpu.parallel import groups
@@ -330,19 +351,27 @@ class Autotuner:
         topo = groups.get_topology()
         world = self._world()
         best: Optional[Experiment] = None
-        for cand in self._candidates():
+        tuner = self.make_tuner()
+        trials = 0
+        while trials < self.max_trials:
+            cand = tuner.next()
+            if cand is None:
+                break
             name = f"z{cand['zero_stage']}_mbs{cand['micro_batch']}"
             if not self.feasible(cand["zero_stage"], cand["micro_batch"],
                                  world):
                 logger.info(f"autotuning: {name} infeasible by memory "
                             f"model, skipped")
+                tuner.update(cand, None)   # steer the surrogate away
                 continue
+            trials += 1
             exp = Experiment(name, self._exp_config(cand))
             groups.set_topology(topo)
             if self.isolate:
                 self._run_experiment_isolated(exp)
             else:
                 self._run_experiment(exp)
+            tuner.update(cand, exp.metric_val)
             self.records.append(exp)
             with open(os.path.join(self.results_dir, f"{name}.json"),
                       "w") as f:

@@ -1,0 +1,127 @@
+"""Tuner strategy family (reference: autotuning/tuner/{base_tuner,
+index_based_tuner,model_based_tuner}.py — GridSearchTuner, RandomTuner,
+and the cost-model-guided ModelBasedTuner).
+
+A tuner proposes candidates SEQUENTIALLY: ``next()`` yields the next
+config to measure, ``update(cand, metric)`` feeds the observation back.
+GridSearch walks the space in order, Random shuffles it, and ModelBased
+fits a least-squares surrogate over observed trials (on features from
+the autotuner's memory/roofline model, including the per-module flops
+estimate when available) and proposes the untried candidate with the
+best predicted metric — the reference's XGBoost cost model reduced to
+its TPU-sized essence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+Candidate = Dict[str, Any]
+
+
+class BaseTuner:
+    def __init__(self, space: List[Candidate],
+                 rng: Optional[np.random.Generator] = None):
+        self.space = list(space)
+        self.rng = rng or np.random.default_rng(0)
+        self.observed: List[tuple] = []          # (cand, metric | None)
+        self._tried: set = set()
+
+    @staticmethod
+    def _key(cand: Candidate):
+        return tuple(sorted(cand.items()))
+
+    def untried(self) -> List[Candidate]:
+        return [c for c in self.space if self._key(c) not in self._tried]
+
+    def next(self) -> Optional[Candidate]:
+        raise NotImplementedError
+
+    def update(self, cand: Candidate, metric: Optional[float]) -> None:
+        """Feed back a measurement (None = failed/infeasible trial)."""
+        self._tried.add(self._key(cand))
+        self.observed.append((cand, metric))
+
+    @property
+    def best(self):
+        done = [(c, m) for c, m in self.observed if m is not None]
+        return max(done, key=lambda cm: cm[1]) if done else None
+
+
+class GridSearchTuner(BaseTuner):
+    """reference index_based_tuner.py GridSearchTuner: in-order sweep."""
+
+    def next(self) -> Optional[Candidate]:
+        rest = self.untried()
+        return rest[0] if rest else None
+
+
+class RandomTuner(BaseTuner):
+    """reference index_based_tuner.py RandomTuner: uniform without
+    replacement."""
+
+    def next(self) -> Optional[Candidate]:
+        rest = self.untried()
+        if not rest:
+            return None
+        return rest[int(self.rng.integers(len(rest)))]
+
+
+class ModelBasedTuner(BaseTuner):
+    """reference model_based_tuner.py: surrogate-guided search.
+
+    ``features_fn(cand) -> sequence of floats`` embeds each candidate
+    (the autotuner supplies memory-model and roofline features, e.g.
+    micro-batch, ZeRO stage, estimated state bytes, flops-derived
+    predicted throughput).  After ``num_seed`` diverse cold-start
+    trials, each proposal fits ridge-regularised least squares on the
+    observations and picks the untried candidate with the highest
+    predicted metric.  Failed trials count as metric 0, steering the
+    surrogate away from similar configs.
+    """
+
+    def __init__(self, space, features_fn: Callable[[Candidate], Any],
+                 rng=None, num_seed: int = 2):
+        super().__init__(space, rng)
+        self.features_fn = features_fn
+        self.num_seed = num_seed
+
+    def _feat(self, cand) -> np.ndarray:
+        f = np.asarray(list(self.features_fn(cand)), np.float64)
+        return np.concatenate([[1.0], f])
+
+    def next(self) -> Optional[Candidate]:
+        rest = self.untried()
+        if not rest:
+            return None
+        n_obs = len(self.observed)
+        if n_obs < self.num_seed:
+            # diverse cold start: endpoints of the space first
+            return rest[0] if n_obs == 0 else rest[-1]
+        x = np.stack([self._feat(c) for c, _m in self.observed])
+        # failed trials count as metric 0: strongly repulsive, so the
+        # surrogate abandons an infeasible region after one sample (a
+        # softer imputation was tried and makes the model chase the
+        # failing frontier instead)
+        y = np.asarray([0.0 if m is None else m
+                        for _c, m in self.observed], np.float64)
+        d = x.shape[1]
+        theta = np.linalg.solve(x.T @ x + 1e-6 * np.eye(d), x.T @ y)
+        preds = [float(self._feat(c) @ theta) for c in rest]
+        return rest[int(np.argmax(preds))]
+
+
+def make_tuner(tuner_type: str, space: List[Candidate],
+               rng: Optional[np.random.Generator] = None,
+               features_fn: Optional[Callable] = None) -> BaseTuner:
+    if tuner_type == "gridsearch":
+        return GridSearchTuner(space, rng)
+    if tuner_type == "random":
+        return RandomTuner(space, rng)
+    if tuner_type == "model_based":
+        if features_fn is None:
+            raise ValueError("model_based tuner needs features_fn")
+        return ModelBasedTuner(space, features_fn, rng)
+    raise ValueError(f"unknown tuner {tuner_type!r}")

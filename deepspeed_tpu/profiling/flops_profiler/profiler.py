@@ -44,6 +44,119 @@ def flops_of(fn: Callable, *args, static_argnums=(), **kwargs) -> float:
     return float(_cost_analysis(lowered.compile()).get("flops", 0.0))
 
 
+# --------------------------------------------------------------------- #
+# Per-module attribution (reference profiler.py's per-module tree — what
+# users actually read, and what the autotuner's cost model consumes).
+# The reference builds it from nn.Module hooks; here the MODULE NAME
+# STACK travels with every jaxpr equation (flax pushes a named scope per
+# module), so a pre-lowering jaxpr walk attributes each dot/conv's FLOPs
+# to the module that issued it — including through pjit/remat/scan
+# sub-jaxprs (scan bodies multiply by trip count).
+# --------------------------------------------------------------------- #
+def _dot_flops(eqn) -> float:
+    lhs_contract = eqn.params["dimension_numbers"][0][0]
+    lhs = eqn.invars[0].aval
+    out = eqn.outvars[0].aval
+    k = 1
+    for d in lhs_contract:
+        k *= lhs.shape[d]
+    return 2.0 * float(np.prod(out.shape, dtype=np.float64)) * k
+
+
+def _conv_flops(eqn) -> float:
+    rhs = eqn.invars[1].aval                 # kernel
+    out = eqn.outvars[0].aval
+    dn = eqn.params["dimension_numbers"]
+    spatial_and_in = [rhs.shape[d] for d in dn.rhs_spec[1:]]
+    k = 1
+    for s in spatial_and_in:
+        k *= s
+    return 2.0 * float(np.prod(out.shape, dtype=np.float64)) * k
+
+
+def _sub_jaxprs(eqn):
+    """(jaxpr, multiplier) pairs nested in an equation (branches handled
+    separately by the visitor — only one executes)."""
+    p = eqn.params
+    if "jaxpr" in p:                         # pjit / closed_call / remat
+        j = p["jaxpr"]
+        yield (j.jaxpr if hasattr(j, "jaxpr") else j), 1
+    if "call_jaxpr" in p:
+        j = p["call_jaxpr"]
+        yield (j.jaxpr if hasattr(j, "jaxpr") else j), 1
+    if "body_jaxpr" in p:
+        yield p["body_jaxpr"].jaxpr, 1
+    if "cond_jaxpr" in p:
+        yield p["cond_jaxpr"].jaxpr, 1
+
+
+def per_module_flops(fn: Callable, *args, **kwargs) -> Dict[str, float]:
+    """Attribute matmul/conv FLOPs of ``fn(*args)`` to the flax module
+    path (name stack) that issued them.  Returns {module_path: flops};
+    '' collects top-level ops outside any named module.  cond/switch
+    count the single most expensive branch (exactly one executes)."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+
+    def visit(jaxpr, mult: float, acc: Dict[str, float]):
+        for eqn in jaxpr.eqns:
+            flops = 0.0
+            if eqn.primitive.name == "dot_general":
+                flops = _dot_flops(eqn)
+            elif eqn.primitive.name == "conv_general_dilated":
+                flops = _conv_flops(eqn)
+            if flops:
+                name = str(eqn.source_info.name_stack)
+                acc[name] = acc.get(name, 0.0) + flops * mult
+            sub_mult = mult
+            if eqn.primitive.name == "scan":
+                sub_mult = mult * eqn.params.get("length", 1)
+            if "branches" in eqn.params:     # exactly one branch runs
+                per_branch = []
+                for br in eqn.params["branches"]:
+                    b_acc: Dict[str, float] = {}
+                    visit(br.jaxpr if hasattr(br, "jaxpr") else br,
+                          sub_mult, b_acc)
+                    per_branch.append(b_acc)
+                if per_branch:
+                    biggest = max(per_branch,
+                                  key=lambda a: sum(a.values()))
+                    for k, v in biggest.items():
+                        acc[k] = acc.get(k, 0.0) + v
+            for sub, m2 in _sub_jaxprs(eqn):
+                visit(sub, sub_mult * m2, acc)
+
+    acc: Dict[str, float] = {}
+    visit(closed.jaxpr, 1.0, acc)
+    return acc
+
+
+def module_tree(per_module: Dict[str, float], depth: int = -1
+                ) -> Dict[str, float]:
+    """Roll leaf name-stack paths up to ``depth`` levels (-1 = leaves)."""
+    if depth < 0:
+        return dict(per_module)
+    out: Dict[str, float] = {}
+    for name, f in per_module.items():
+        key = "/".join(name.split("/")[:depth]) if name else ""
+        out[key] = out.get(key, 0.0) + f
+    return out
+
+
+def format_module_profile(per_module: Dict[str, float], depth: int = 2,
+                          top: int = 0) -> str:
+    """Reference-style per-module table: flops, share of total."""
+    rolled = module_tree(per_module, depth)
+    total = sum(rolled.values()) or 1.0
+    rows = sorted(rolled.items(), key=lambda kv: -kv[1])
+    if top:
+        rows = rows[:top]
+    lines = [f"{'module':<44}{'flops':>14}{'share':>9}"]
+    for name, f in rows:
+        lines.append(f"{(name or '<top-level>'):<44}"
+                     f"{flops_to_string(f):>14}{f / total:>8.1%}")
+    return "\n".join(lines)
+
+
 def params_of(tree) -> int:
     return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(tree)
                if hasattr(l, "shape"))
@@ -105,6 +218,7 @@ class FlopsProfiler:
         self._duration = 0.0
         self._params = 0
         self._per_program: Dict[str, Dict[str, float]] = {}
+        self._per_module: Dict[str, float] = {}
 
     def start_profile(self, ignore_list=None):
         del ignore_list
@@ -138,14 +252,25 @@ class FlopsProfiler:
         self._duration += duration
 
     def profile_fn(self, fn: Callable, *args, name: str = "fn", **kwargs):
-        """Lower/compile ``fn``, time one execution, record its cost."""
+        """Lower/compile ``fn``, time one execution, record its cost —
+        including the per-module attribution (name-stack jaxpr walk)."""
         compiled = jax.jit(fn).lower(*args, **kwargs).compile()
         t0 = time.time()
         out = compiled(*args, **kwargs)
         jax.block_until_ready(out)
         dt = time.time() - t0
         self.profile_compiled(name, compiled, duration=dt)
+        try:
+            self._per_module = per_module_flops(fn, *args, **kwargs)
+        except Exception as e:  # pragma: no cover — attribution is best-
+            self._per_module = {}  # never report a stale fn's profile
+            logger.warning(f"per-module attribution failed: {e}")  # effort
         return out
+
+    def get_module_profile(self, depth: int = -1) -> Dict[str, float]:
+        """Per-module flops of the last ``profile_fn`` call (reference
+        per-module tree; {} until a fn has been profiled)."""
+        return module_tree(getattr(self, "_per_module", {}), depth)
 
     # -- reference getters -------------------------------------------- #
     def get_total_flops(self, as_string: bool = False):
@@ -166,7 +291,6 @@ class FlopsProfiler:
     def print_model_profile(self, profile_step: int = 1, module_depth: int = -1,
                             top_modules: int = 1, detailed: bool = True,
                             output_file: Optional[str] = None):
-        del module_depth, top_modules
         lines = [
             "-" * 60,
             "DeepSpeed-TPU Flops Profiler (XLA cost analysis)",
@@ -176,6 +300,15 @@ class FlopsProfiler:
             f"fwd+bwd MACs per step:          {self.get_total_macs(True)}",
             f"measured duration:              {self.get_total_duration(True)}",
         ]
+        if getattr(self, "_per_module", None):
+            lines.append("-" * 60)
+            lines.append("per-module flops (name-stack attribution):")
+            lines.append(format_module_profile(
+                self._per_module,
+                depth=(module_depth if module_depth and module_depth > 0
+                       else 2),
+                # detailed -> full breakdown; summary -> top rows only
+                top=0 if detailed else max(top_modules, 1)))
         if self._duration > 0:
             lines.append(
                 f"achieved:                       "

@@ -55,11 +55,19 @@ def test_memory_model_prefers_sharded_stages(tmp_path):
     assert b3 < b0 / 4
 
 
-def test_model_based_order(tmp_path):
+def test_model_based_strategy_wiring(tmp_path):
+    """The autotuner builds a sequential ModelBasedTuner over its search
+    space with memory-model features (strategy family in
+    autotuning/tuner.py; behaviour tested in test_tuner_strategies)."""
+    from deepspeed_tpu.autotuning.tuner import ModelBasedTuner
+
     tuner = _tuner(tmp_path, tuner_type="model_based",
-                   micro_batch_sizes=[2], zero_stages=[0, 3])
-    cands = tuner._candidates()
-    assert cands[0]["zero_stage"] == 3  # cheapest memory first
+                   micro_batch_sizes=[2, 4], zero_stages=[0, 3])
+    strat = tuner.make_tuner()
+    assert isinstance(strat, ModelBasedTuner)
+    assert len(strat.space) == 4
+    feats = tuner.candidate_features({"zero_stage": 3, "micro_batch": 4})
+    assert len(feats) >= 4 and feats[0] == 4.0
 
 
 def test_isolated_experiments_survive_hard_crash(tmp_path):
