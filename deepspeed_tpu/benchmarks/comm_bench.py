@@ -20,7 +20,6 @@ import time
 def _bench_collective(op: str, nbytes: int, mesh, axis: str, iters: int):
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     world = mesh.shape[axis]
@@ -55,8 +54,8 @@ def _bench_collective(op: str, nbytes: int, mesh, axis: str, iters: int):
             for _ in range(iters):
                 x = step(x)
             return x
-        return shard_map(inner, mesh=mesh, in_specs=spec, out_specs=spec,
-                         check_rep=False)(x)
+        return jax.shard_map(inner, mesh=mesh, in_specs=spec,
+                             out_specs=spec, check_vma=False)(x)
 
     x = jax.device_put(jnp.ones((n,), jnp.float32),
                        NamedSharding(mesh, spec))
@@ -105,10 +104,13 @@ def main(args=None) -> int:
     while size <= ns.maxsize:
         for op in ns.ops.split(","):
             dt = _bench_collective(op, size, mesh, axis, ns.iters)
-            # algorithmic -> bus bandwidth factors (ring algorithms)
+            # nccl-tests bus-bandwidth convention: allreduce and alltoall
+            # are defined over the per-rank buffer (which `size` is),
+            # allgather/reducescatter over the TOTAL gathered buffer —
+            # those scale by world before the ring factor
             factor = {"allreduce": 2 * (world - 1) / world,
-                      "allgather": (world - 1) / world,
-                      "reducescatter": (world - 1) / world,
+                      "allgather": world * (world - 1) / world,
+                      "reducescatter": world * (world - 1) / world,
                       "alltoall": (world - 1) / world}[op]
             bw = size * factor / dt / 1e9
             print(f"{op:<14}{size:>12}{dt * 1e3:>10.3f}ms{bw:>12.2f}")

@@ -235,6 +235,132 @@ def _falcon_rules() -> List[Rule]:
     ]
 
 
+def _ln(path_fn):
+    """LayerNorm rule helper: weight->scale, bias->bias (both as-is)."""
+    def build(m):
+        *head, kind = path_fn(m)
+        return (tuple(head) + ("scale" if kind == "weight" else "bias",),
+                None)
+    return build
+
+
+def _dense(path_fn):
+    """Linear rule helper: weight->kernel (transposed), bias->bias."""
+    def build(m):
+        *head, kind = path_fn(m)
+        if kind == "weight":
+            return tuple(head) + ("kernel",), "t"
+        return tuple(head) + ("bias",), None
+    return build
+
+
+def _bloom_rules() -> List[Rule]:
+    return [
+        (r"^(?:transformer\.)?word_embeddings\.weight$",
+         lambda m: (("word_embeddings", "embedding"), None)),
+        (r"^(?:transformer\.)?word_embeddings_layernorm\.(weight|bias)$",
+         _ln(lambda m: ("word_embeddings_layernorm", m.group(1)))),
+        (r"^(?:transformer\.)?h\.(\d+)\."
+         r"(input_layernorm|post_attention_layernorm)\.(weight|bias)$",
+         _ln(lambda m: (f"h_{m.group(1)}", m.group(2), m.group(3)))),
+        (r"^(?:transformer\.)?h\.(\d+)\.self_attention\."
+         r"(query_key_value|dense)\.(weight|bias)$",
+         _dense(lambda m: (f"h_{m.group(1)}", "self_attention",
+                           m.group(2), m.group(3)))),
+        (r"^(?:transformer\.)?h\.(\d+)\.mlp\."
+         r"(dense_h_to_4h|dense_4h_to_h)\.(weight|bias)$",
+         _dense(lambda m: (f"h_{m.group(1)}", "mlp", m.group(2),
+                           m.group(3)))),
+        (r"^(?:transformer\.)?ln_f\.(weight|bias)$",
+         _ln(lambda m: ("ln_f", m.group(1)))),
+        (r"^lm_head\.weight$", lambda m: (None, None)),  # tied
+    ]
+
+
+def _gptj_rules() -> List[Rule]:
+    return [
+        (r"^(?:transformer\.)?wte\.weight$",
+         lambda m: (("wte", "embedding"), None)),
+        (r"^(?:transformer\.)?h\.(\d+)\.ln_1\.(weight|bias)$",
+         _ln(lambda m: (f"h_{m.group(1)}", "ln_1", m.group(2)))),
+        (r"^(?:transformer\.)?h\.(\d+)\.attn\."
+         r"(q_proj|k_proj|v_proj|out_proj)\.weight$",
+         _dense(lambda m: (f"h_{m.group(1)}", "attn", m.group(2),
+                           "weight"))),
+        (r"^(?:transformer\.)?h\.(\d+)\.mlp\.(fc_in|fc_out)\."
+         r"(weight|bias)$",
+         _dense(lambda m: (f"h_{m.group(1)}", m.group(2), m.group(3)))),
+        (r"^(?:transformer\.)?ln_f\.(weight|bias)$",
+         _ln(lambda m: ("ln_f", m.group(1)))),
+        (r"^lm_head\.(weight|bias)$",
+         _dense(lambda m: ("lm_head", m.group(1)))),
+        (r".*\.attn\.(bias|masked_bias)$", lambda m: (None, None)),
+    ]
+
+
+def _gptneox_rules() -> List[Rule]:
+    return [
+        (r"^gpt_neox\.embed_in\.weight$",
+         lambda m: (("embed_in", "embedding"), None)),
+        (r"^gpt_neox\.layers\.(\d+)\."
+         r"(input_layernorm|post_attention_layernorm)\.(weight|bias)$",
+         _ln(lambda m: (f"layers_{m.group(1)}", m.group(2), m.group(3)))),
+        (r"^gpt_neox\.layers\.(\d+)\.attention\."
+         r"(query_key_value|dense)\.(weight|bias)$",
+         _dense(lambda m: (f"layers_{m.group(1)}", "attention",
+                           m.group(2), m.group(3)))),
+        (r"^gpt_neox\.layers\.(\d+)\.mlp\."
+         r"(dense_h_to_4h|dense_4h_to_h)\.(weight|bias)$",
+         _dense(lambda m: (f"layers_{m.group(1)}", "mlp", m.group(2),
+                           m.group(3)))),
+        (r"^gpt_neox\.final_layer_norm\.(weight|bias)$",
+         _ln(lambda m: ("final_layer_norm", m.group(1)))),
+        (r"^embed_out\.weight$",
+         lambda m: (("embed_out", "kernel"), "t")),
+        (r"^gpt_neox\.layers\.\d+\.attention\."
+         r"(bias|masked_bias|rotary_emb\.inv_freq)$",
+         lambda m: (None, None)),
+    ]
+
+
+def _bert_rules() -> List[Rule]:
+    return [
+        (r"^(?:bert\.)?embeddings\.(word_embeddings|position_embeddings|"
+         r"token_type_embeddings)\.weight$",
+         lambda m: (("embeddings", m.group(1), "embedding"), None)),
+        (r"^(?:bert\.)?embeddings\.LayerNorm\.(weight|bias)$",
+         _ln(lambda m: ("embeddings", "layer_norm", m.group(1)))),
+        (r"^(?:bert\.)?encoder\.layer\.(\d+)\.attention\.self\."
+         r"(query|key|value)\.(weight|bias)$",
+         _dense(lambda m: ("encoder", f"layer_{m.group(1)}", "attention",
+                           "self", m.group(2), m.group(3)))),
+        (r"^(?:bert\.)?encoder\.layer\.(\d+)\.attention\.output\.dense\."
+         r"(weight|bias)$",
+         _dense(lambda m: ("encoder", f"layer_{m.group(1)}", "attention",
+                           "output", "dense", m.group(2)))),
+        (r"^(?:bert\.)?encoder\.layer\.(\d+)\.attention\.output\."
+         r"LayerNorm\.(weight|bias)$",
+         _ln(lambda m: ("encoder", f"layer_{m.group(1)}", "attention",
+                        "output", "layer_norm", m.group(2)))),
+        (r"^(?:bert\.)?encoder\.layer\.(\d+)\.intermediate\.dense\."
+         r"(weight|bias)$",
+         _dense(lambda m: ("encoder", f"layer_{m.group(1)}",
+                           "intermediate", "dense", m.group(2)))),
+        (r"^(?:bert\.)?encoder\.layer\.(\d+)\.output\.dense\."
+         r"(weight|bias)$",
+         _dense(lambda m: ("encoder", f"layer_{m.group(1)}", "output",
+                           "dense", m.group(2)))),
+        (r"^(?:bert\.)?encoder\.layer\.(\d+)\.output\.LayerNorm\."
+         r"(weight|bias)$",
+         _ln(lambda m: ("encoder", f"layer_{m.group(1)}", "output",
+                        "layer_norm", m.group(2)))),
+        (r"^(?:bert\.)?pooler\.dense\.(weight|bias)$",
+         _dense(lambda m: ("pooler", "dense", m.group(1)))),
+        (r"^(?:bert\.)?embeddings\.position_ids$",
+         lambda m: (None, None)),
+    ]
+
+
 _ARCH_RULES: Dict[str, Callable[[], List[Rule]]] = {
     "llama": _llama_rules,
     "mistral": _llama_rules,     # same architecture/serialization
@@ -243,6 +369,11 @@ _ARCH_RULES: Dict[str, Callable[[], List[Rule]]] = {
     "gpt2": _gpt2_rules,
     "opt": _opt_rules,
     "falcon": _falcon_rules,
+    "bloom": _bloom_rules,
+    "gptj": _gptj_rules,
+    "gpt_neox": _gptneox_rules,
+    "gptneox": _gptneox_rules,
+    "bert": _bert_rules,
 }
 
 
@@ -347,6 +478,62 @@ def config_from_hf(model_path: str, dtype: Any = None):
             rope_theta=cfg.get("rope_theta", 10000.0),
             bias=cfg.get("bias", False),
             dtype=dt)
+    if arch == "bloom":
+        from deepspeed_tpu.models.bloom import BloomConfig
+
+        return arch, BloomConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg.get("hidden_size", cfg.get("n_embed")),
+            num_hidden_layers=cfg.get("n_layer",
+                                      cfg.get("num_hidden_layers")),
+            num_attention_heads=cfg.get("n_head",
+                                        cfg.get("num_attention_heads")),
+            layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-5),
+            apply_residual_connection_post_layernorm=cfg.get(
+                "apply_residual_connection_post_layernorm", False),
+            dtype=dt)
+    if arch == "gptj":
+        from deepspeed_tpu.models.gptj import GPTJConfig
+
+        return arch, GPTJConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["n_embd"],
+            num_hidden_layers=cfg["n_layer"],
+            num_attention_heads=cfg["n_head"],
+            rotary_dim=cfg.get("rotary_dim") or cfg["n_embd"] //
+            cfg["n_head"],
+            max_position_embeddings=cfg["n_positions"],
+            layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-5),
+            dtype=dt)
+    if arch in ("gpt_neox", "gptneox"):
+        from deepspeed_tpu.models.gptneox import GPTNeoXConfig
+
+        return arch, GPTNeoXConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg["intermediate_size"],
+            num_hidden_layers=cfg["num_hidden_layers"],
+            num_attention_heads=cfg["num_attention_heads"],
+            rotary_pct=cfg.get("rotary_pct", 0.25),
+            rope_theta=cfg.get("rotary_emb_base",
+                               cfg.get("rope_theta", 10000.0)),
+            max_position_embeddings=cfg["max_position_embeddings"],
+            layer_norm_eps=cfg.get("layer_norm_eps", 1e-5),
+            use_parallel_residual=cfg.get("use_parallel_residual", True),
+            dtype=dt)
+    if arch == "bert":
+        from deepspeed_tpu.models.bert import BertConfig
+
+        return arch, BertConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg["intermediate_size"],
+            num_hidden_layers=cfg["num_hidden_layers"],
+            num_attention_heads=cfg["num_attention_heads"],
+            max_position_embeddings=cfg["max_position_embeddings"],
+            type_vocab_size=cfg.get("type_vocab_size", 2),
+            layer_norm_eps=cfg.get("layer_norm_eps", 1e-12),
+            dtype=dt)
     raise HFLoadError(f"unsupported model_type {arch!r} in {model_path}")
 
 
@@ -386,8 +573,13 @@ def load_hf_checkpoint(model_path: str, architecture: Optional[str] = None,
         raise ValueError(
             "to_device=False keeps tensors on the host; it cannot be "
             "combined with mesh= (which device_puts every tensor)")
+    try:
+        file_cfg = hf_config(model_path)
+    except FileNotFoundError:
+        # config.json is optional when architecture= is given explicitly
+        file_cfg = {}
     if architecture is None:
-        architecture = hf_config(model_path).get("model_type", "")
+        architecture = file_cfg.get("model_type", "")
     arch = architecture.lower()
     if arch not in _ARCH_RULES:
         raise HFLoadError(
@@ -403,6 +595,21 @@ def load_hf_checkpoint(model_path: str, architecture: Optional[str] = None,
 
     tree: Dict[str, Any] = {}
     stacks: Dict[Tuple[str, ...], Dict[int, Any]] = {}
+    # Flush a leaf's expert stack the moment its last expert arrives, so at
+    # most one layer's expert set is host-resident (Mixtral expert weights
+    # are ~95% of parameters; buffering them all would hold the whole model
+    # on the host, defeating the streaming design).
+    n_experts = file_cfg.get("num_local_experts") or \
+        file_cfg.get("num_experts")
+
+    def flush_stack(path):
+        parts = stacks.pop(path)
+        n = max(parts) + 1
+        if set(parts) != set(range(n)):
+            raise HFLoadError(
+                f"missing expert shards for {'/'.join(path)}: "
+                f"have {sorted(parts)}")
+        place(path, np.stack([parts[i] for i in range(n)]))
 
     def place(path, arr):
         if not to_device and mesh is None:
@@ -435,6 +642,8 @@ def load_hf_checkpoint(model_path: str, architecture: Optional[str] = None,
                 break
             if isinstance(tf, tuple) and tf[0] == "stack":
                 stacks.setdefault(path, {})[tf[1]] = np.asarray(tensor).T
+                if n_experts and len(stacks[path]) == n_experts:
+                    flush_stack(path)
             else:
                 arr = tensor.T if tf == "t" else tensor
                 place(path, arr)
@@ -445,13 +654,8 @@ def load_hf_checkpoint(model_path: str, architecture: Optional[str] = None,
         raise HFLoadError(
             f"unmapped tensors for {arch}: {unmapped[:8]}"
             + (f" (+{len(unmapped) - 8} more)" if len(unmapped) > 8 else ""))
-    for path, parts in stacks.items():
-        n = max(parts) + 1
-        if set(parts) != set(range(n)):
-            raise HFLoadError(
-                f"missing expert shards for {'/'.join(path)}: "
-                f"have {sorted(parts)}")
-        place(path, np.stack([parts[i] for i in range(n)]))
+    for path in list(stacks):
+        flush_stack(path)
     return tree
 
 
@@ -480,4 +684,20 @@ def model_from_hf(model_path: str, dtype: Any = None):
         from deepspeed_tpu.models.falcon import FalconForCausalLM
 
         return arch, cfg, FalconForCausalLM(cfg)
+    if arch == "bloom":
+        from deepspeed_tpu.models.bloom import BloomForCausalLM
+
+        return arch, cfg, BloomForCausalLM(cfg)
+    if arch == "gptj":
+        from deepspeed_tpu.models.gptj import GPTJForCausalLM
+
+        return arch, cfg, GPTJForCausalLM(cfg)
+    if arch in ("gpt_neox", "gptneox"):
+        from deepspeed_tpu.models.gptneox import GPTNeoXForCausalLM
+
+        return arch, cfg, GPTNeoXForCausalLM(cfg)
+    if arch == "bert":
+        from deepspeed_tpu.models.bert import BertModel
+
+        return arch, cfg, BertModel(cfg)
     raise HFLoadError(f"no model class for architecture {arch!r}")

@@ -375,9 +375,25 @@ class InferenceEngineV2:
         key = (tuple(uids), tuple(s.seen_tokens for s in seqs))
         if state is None or tables_changed or state["key"] != key:
             state = self._upload_decode_state(seqs, key)
-        logits, nxt, new_cache, new_pos = self._get_decode_step()(
-            self.params, sm.kv_cache.cache, state["tables"], state["pos"],
-            self._as_token_array(tokens, n, S))
+        try:
+            logits, nxt, new_cache, new_pos = self._get_decode_step()(
+                self.params, sm.kv_cache.cache, state["tables"],
+                state["pos"], self._as_token_array(tokens, n, S))
+        except Exception:
+            # the jitted step donates the cache and pos buffers; if it
+            # raises after donation both may reference consumed arrays.
+            # The KV content is unrecoverable at that point — drop the
+            # cached decode state, reallocate a zeroed cache, and flush
+            # every live sequence so subsequent calls start clean instead
+            # of passing deleted buffers.
+            self._dev_decode_state = None
+            for leaf in jax.tree_util.tree_leaves(sm.kv_cache.cache):
+                if getattr(leaf, "is_deleted", lambda: False)():
+                    sm.kv_cache.update(jax.tree_util.tree_map(
+                        jnp.zeros_like, sm.kv_cache.cache))
+                    sm.flush(list(sm._seqs))
+                    break
+            raise
         sm.kv_cache.update(new_cache)
         for seq in seqs:
             seq.seen_tokens += 1

@@ -134,9 +134,12 @@ def quantized_matmul(x: jnp.ndarray, record, tile_n: int = 256,
     # kernel overhead — the XLA grouped-dequant composition (int8 still
     # resident in HBM) is faster there; the kernel wins at prefill sizes
     # where avoiding the materialised bf16 copy matters
-    run_kernel = (rpg_tile is not None and n % tile_n == 0
-                  and (m >= 64 if interpret is None else True)
-                  and (interpret is not None or _on_tpu()))
+    # interpret=True forces the interpret-mode kernel (test path, any
+    # backend); the compiled kernel additionally requires a TPU and the
+    # size heuristic regardless of how interpret was spelled
+    tiles_ok = rpg_tile is not None and n % tile_n == 0
+    run_kernel = tiles_ok and (
+        interpret is True or (m >= 64 and _on_tpu()))
     if not run_kernel:
         return x @ dequant_reference(record, x.dtype)
     # pad M to the bf16 sublane multiple

@@ -318,9 +318,18 @@ def test_mpi_discovery_from_slurm_env(monkeypatch):
     # rank 0's host = first nodelist entry (block distribution)
     assert os.environ["COORDINATOR_ADDRESS"] == "node-a:12345"
 
-    # compressed ranges can't be parsed without scontrol -> left unset
+    # compressed ranges are expanded by the pure-python prefix[NN-MM]
+    # fallback even when scontrol is unavailable (comm/comm.py:78)
     monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
     monkeypatch.setenv("SLURM_JOB_NODELIST", "node[01-04]")
+    monkeypatch.delenv("RANK", raising=False)
+    monkeypatch.delenv("WORLD_SIZE", raising=False)
+    mpi_discovery(distributed_port=12345, verbose=False)
+    assert os.environ["COORDINATOR_ADDRESS"] == "node01:12345"
+
+    # a nodelist no parser understands is left unset so init fails loudly
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "[weird")
     monkeypatch.delenv("RANK", raising=False)
     monkeypatch.delenv("WORLD_SIZE", raising=False)
     mpi_discovery(distributed_port=12345, verbose=False)
