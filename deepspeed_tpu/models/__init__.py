@@ -22,3 +22,11 @@ __all__ += ["MistralConfig", "MistralForCausalLM", "mistral_tiny",
 from deepspeed_tpu.models.falcon import FalconConfig, FalconForCausalLM
 
 __all__ += ["FalconConfig", "FalconForCausalLM"]
+from deepspeed_tpu.models.bloom import BloomConfig, BloomForCausalLM
+from deepspeed_tpu.models.gptj import GPTJConfig, GPTJForCausalLM
+from deepspeed_tpu.models.gptneox import GPTNeoXConfig, GPTNeoXForCausalLM
+from deepspeed_tpu.models.bert import BertConfig, BertModel
+
+__all__ += ["BloomConfig", "BloomForCausalLM", "GPTJConfig",
+            "GPTJForCausalLM", "GPTNeoXConfig", "GPTNeoXForCausalLM",
+            "BertConfig", "BertModel"]

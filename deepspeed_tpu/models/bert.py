@@ -1,0 +1,185 @@
+"""BERT encoder (parity target: the reference's BERT inference support —
+``module_inject/containers/bert.py`` HFBertLayerPolicy + the
+DeepSpeedTransformer training kernels, ``csrc/transformer/``, whose
+published benchmark is BERT pre-training).
+
+Bidirectional encoder: word + position + token-type embeddings under a
+LayerNorm, post-LN residual blocks (attention out and MLP out each add
+into the stream BEFORE their LayerNorm — the original post-norm BERT,
+not the pre-norm GPT arrangement), exact GELU, and a tanh pooler over
+the [CLS] token.  Param paths mirror the HF module tree so the 'bert'
+TP policy (replace_policy.py) applies verbatim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=128)
+        base.update(kw)
+        return BertConfig(**base)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.config
+        h, d = cfg.num_attention_heads, cfg.head_dim
+        proj = lambda name: nn.Dense(
+            h * d, use_bias=True, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        shape = (*x.shape[:2], h, d)
+        q = proj("query")(x).reshape(shape)
+        k = proj("key")(x).reshape(shape)
+        v = proj("value")(x).reshape(shape)
+        out = dot_product_attention(q, k, v, causal=False, mask=mask)
+        return out.reshape(*x.shape[:2], h * d)
+
+
+class BertAddNorm(nn.Module):
+    """dense -> +residual -> LayerNorm (post-norm); serves as both
+    ``attention/output`` and the block-level ``output`` module."""
+
+    config: BertConfig
+    features: int
+
+    @nn.compact
+    def __call__(self, x, residual):
+        cfg = self.config
+        y = nn.Dense(self.features, use_bias=True, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="dense")(x)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                            name="layer_norm")(
+            y + residual).astype(cfg.dtype)
+
+
+class BertAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        ctx = BertSelfAttention(self.config, name="self")(x, mask)
+        return BertAddNorm(self.config, self.config.hidden_size,
+                           name="output")(ctx, x)
+
+
+class BertIntermediate(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Dense(self.config.intermediate_size, use_bias=True,
+                     dtype=self.config.dtype, param_dtype=jnp.float32,
+                     name="dense")(x)
+        return nn.gelu(y, approximate=False)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.config
+        x = BertAttention(cfg, name="attention")(x, mask)
+        inter = BertIntermediate(cfg, name="intermediate")(x)
+        return BertAddNorm(cfg, cfg.hidden_size, name="output")(inter, x)
+
+
+class BertEmbeddings(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids):
+        cfg = self.config
+        s = input_ids.shape[1]
+        emb = lambda n, name: nn.Embed(
+            n, cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name=name)
+        positions = jnp.arange(s, dtype=jnp.int32)[None]
+        x = (emb(cfg.vocab_size, "word_embeddings")(input_ids)
+             + emb(cfg.max_position_embeddings,
+                   "position_embeddings")(positions)
+             + emb(cfg.type_vocab_size,
+                   "token_type_embeddings")(token_type_ids))
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                            name="layer_norm")(x).astype(cfg.dtype)
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.config
+        layer = nn.remat(BertLayer) if cfg.remat else BertLayer
+        for i in range(cfg.num_hidden_layers):
+            x = layer(cfg, name=f"layer_{i}")(x, mask)
+        return x
+
+
+class BertPooler(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Dense(self.config.hidden_size, use_bias=True,
+                     dtype=self.config.dtype, param_dtype=jnp.float32,
+                     name="dense")(x[:, 0])
+        return jnp.tanh(y)
+
+
+class BertModel(nn.Module):
+    """Returns ``(last_hidden_state, pooler_output)`` like HF BertModel."""
+
+    config: BertConfig
+
+    @property
+    def partition_rules(self):
+        from deepspeed_tpu.module_inject.replace_policy import policy_for
+
+        return policy_for("bert")
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None,
+                 attention_mask: Optional[jax.Array] = None):
+        cfg = self.config
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = BertEmbeddings(cfg, name="embeddings")(input_ids,
+                                                   token_type_ids)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        x = BertEncoder(cfg, name="encoder")(x, mask)
+        return x, BertPooler(cfg, name="pooler")(x)

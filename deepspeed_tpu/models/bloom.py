@@ -1,0 +1,149 @@
+"""BLOOM causal LM (parity target: the reference's BLOOM support —
+``module_inject/containers/bloom.py`` weight map + the ALiBi path in
+``csrc/transformer/inference/csrc/softmax.cu`` attn_softmax ALiBi
+handling).
+
+Architecture: ALiBi positional bias (no rotary/learned positions), fused
+QKV with the per-head ``[h, 3, d]`` interleave, a LayerNorm directly on
+the embeddings, tanh-approximate GELU, tied unembedding.  ALiBi is an
+additive per-head bias ``m_h * j`` over key positions — softmax
+shift-invariance makes that equal to the canonical ``-m_h * (i - j)``
+form, and it rides the XLA attention path as a broadcast bias.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import cross_entropy_loss
+from deepspeed_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass
+class BloomConfig:
+    vocab_size: int = 250880
+    hidden_size: int = 4096
+    num_hidden_layers: int = 30
+    num_attention_heads: int = 32
+    layer_norm_epsilon: float = 1e-5
+    apply_residual_connection_post_layernorm: bool = False
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def tiny(**kw) -> "BloomConfig":
+        base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4)
+        base.update(kw)
+        return BloomConfig(**base)
+
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes (the train-short-test-long geometric series;
+    non-power-of-2 head counts interleave a second series — same scheme
+    the reference's softmax kernel bakes in)."""
+    closest = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = [base ** p for p in range(1, closest + 1)]
+    if closest != num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        slopes += [extra_base ** p
+                   for p in range(1, 2 * (num_heads - closest), 2)]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def split_fused_qkv_per_head(qkv, h: int, d: int):
+    """Split a fused [..., h*3*d] projection laid out per-head as
+    [h, (q k v), d] (BLOOM / GPT-NeoX checkpoint convention — NOT the
+    [q-block, k-block, v-block] concat Llama-style fused layouts use)."""
+    parts = qkv.reshape(*qkv.shape[:-1], h, 3, d)
+    return parts[..., 0, :], parts[..., 1, :], parts[..., 2, :]
+
+
+class BloomAttention(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, ln):
+        cfg = self.config
+        h, d = cfg.num_attention_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        qkv = dense(3 * cfg.hidden_size, "query_key_value")(ln)
+        q, k, v = split_fused_qkv_per_head(qkv, h, d)
+        s = ln.shape[1]
+        # additive bias m_h * j over key positions [1, H, 1, Sk]
+        bias = alibi_slopes(h)[None, :, None, None] * \
+            jnp.arange(s, dtype=jnp.float32)[None, None, None, :]
+        out = dot_product_attention(q, k, v, causal=True, bias=bias)
+        return dense(cfg.hidden_size, "dense")(
+            out.reshape(*ln.shape[:2], h * d))
+
+
+class BloomMLP(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, ln):
+        cfg = self.config
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        # BLOOM's bloom_gelu == tanh-approximate GELU
+        return dense(cfg.hidden_size, "dense_4h_to_h")(
+            nn.gelu(dense(4 * cfg.hidden_size, "dense_h_to_4h")(ln),
+                    approximate=True))
+
+
+class BloomBlock(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        norm = lambda name: nn.LayerNorm(
+            epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, name=name)
+        ln1 = norm("input_layernorm")(x).astype(cfg.dtype)
+        res = ln1 if cfg.apply_residual_connection_post_layernorm else x
+        x = res + BloomAttention(cfg, name="self_attention")(ln1)
+        ln2 = norm("post_attention_layernorm")(x).astype(cfg.dtype)
+        res = ln2 if cfg.apply_residual_connection_post_layernorm else x
+        return res + BloomMLP(cfg, name="mlp")(ln2)
+
+
+class BloomForCausalLM(nn.Module):
+    config: BloomConfig
+
+    @property
+    def partition_rules(self):
+        from deepspeed_tpu.module_inject.replace_policy import policy_for
+
+        return policy_for("bloom")
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.config
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="word_embeddings")
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+                         name="word_embeddings_layernorm")(
+            embed(input_ids)).astype(cfg.dtype)
+        block = nn.remat(BloomBlock) if cfg.remat else BloomBlock
+        for i in range(cfg.num_hidden_layers):
+            x = block(cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+                         name="ln_f")(x)
+        logits = embed.attend(x.astype(cfg.dtype))  # tied unembedding
+        if labels is not None:
+            return cross_entropy_loss(logits, labels)
+        return logits

@@ -1,0 +1,140 @@
+"""GPT-J causal LM (parity target: the reference's GPT-J support —
+``module_inject/containers/gptj.py`` + the HFGPTJLayerPolicy weight map).
+
+Architecture: parallel residual (attention and MLP both read ``ln_1``'s
+output), bias-free attention projections, partial rotary embeddings over
+the first ``rotary_dim`` dims in the INTERLEAVED pairing (rotate-every-
+two: pairs are adjacent even/odd lanes, not the half-split Llama uses),
+tanh-approximate GELU MLP, and an untied biased LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import cross_entropy_loss
+from deepspeed_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass
+class GPTJConfig:
+    vocab_size: int = 50400
+    hidden_size: int = 4096
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 16
+    rotary_dim: int = 64
+    max_position_embeddings: int = 2048
+    layer_norm_epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def tiny(**kw) -> "GPTJConfig":
+        base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, rotary_dim=8,
+                    max_position_embeddings=128)
+        base.update(kw)
+        return GPTJConfig(**base)
+
+
+def rotary_interleaved(positions: jax.Array, rotary_dim: int):
+    """(cos, sin): [B,S,1,rotary_dim] fp32 with each frequency REPEATED
+    over adjacent lane pairs (GPT-J's repeat_interleave convention)."""
+    inv_freq = 1.0 / (10000.0 ** (
+        jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,S,R/2]
+    angles = jnp.repeat(angles, 2, axis=-1)                       # [B,S,R]
+    return jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+
+
+def apply_rotary_interleaved(x, cos, sin):
+    """x: [B,S,H,R]; rotate-every-two: (x0,x1) -> (x0 c - x1 s,
+    x1 c + x0 s) per adjacent pair."""
+    x32 = x.astype(jnp.float32)
+    x1 = x32[..., ::2]
+    x2 = x32[..., 1::2]
+    rotated = jnp.stack([-x2, x1], axis=-1).reshape(x32.shape)
+    return (x32 * cos + rotated * sin).astype(x.dtype)
+
+
+class GPTJAttention(nn.Module):
+    config: GPTJConfig
+
+    @nn.compact
+    def __call__(self, ln, positions):
+        cfg = self.config
+        h, d, r = cfg.num_attention_heads, cfg.head_dim, cfg.rotary_dim
+        proj = lambda feats, name, bias=False: nn.Dense(
+            feats, use_bias=bias, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        shape = (*ln.shape[:2], h, d)
+        q = proj(h * d, "q_proj")(ln).reshape(shape)
+        k = proj(h * d, "k_proj")(ln).reshape(shape)
+        v = proj(h * d, "v_proj")(ln).reshape(shape)
+        cos, sin = rotary_interleaved(positions, r)
+        q = jnp.concatenate(
+            [apply_rotary_interleaved(q[..., :r], cos, sin), q[..., r:]],
+            axis=-1)
+        k = jnp.concatenate(
+            [apply_rotary_interleaved(k[..., :r], cos, sin), k[..., r:]],
+            axis=-1)
+        out = dot_product_attention(q, k, v, causal=True)
+        return proj(cfg.hidden_size, "out_proj")(
+            out.reshape(*ln.shape[:2], h * d))
+
+
+class GPTJBlock(nn.Module):
+    config: GPTJConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        ln = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+                          name="ln_1")(x).astype(cfg.dtype)
+        attn = GPTJAttention(cfg, name="attn")(ln, positions)
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        mlp = dense(cfg.hidden_size, "fc_out")(
+            nn.gelu(dense(4 * cfg.hidden_size, "fc_in")(ln),
+                    approximate=True))
+        return x + attn + mlp  # parallel residual
+
+
+class GPTJForCausalLM(nn.Module):
+    config: GPTJConfig
+
+    @property
+    def partition_rules(self):
+        from deepspeed_tpu.module_inject.replace_policy import policy_for
+
+        return policy_for("gptj")
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.config
+        b, s = input_ids.shape
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="wte")(input_ids)
+        positions = jnp.broadcast_to(
+            jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+        block = nn.remat(GPTJBlock) if cfg.remat else GPTJBlock
+        for i in range(cfg.num_hidden_layers):
+            x = block(cfg, name=f"h_{i}")(x, positions)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+                         name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=True, dtype=cfg.dtype,
+                          param_dtype=jnp.float32,
+                          name="lm_head")(x.astype(cfg.dtype))
+        if labels is not None:
+            return cross_entropy_loss(logits, labels)
+        return logits

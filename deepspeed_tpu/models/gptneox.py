@@ -1,0 +1,143 @@
+"""GPT-NeoX causal LM (parity target: the reference's GPT-NeoX support —
+``module_inject/containers/gptneox.py`` + its megatron-style qkv weight
+map).
+
+Architecture: fused QKV in the per-head ``[h, 3, d]`` interleave (the
+megatron convention BLOOM shares), PARTIAL rotary embeddings — the first
+``rotary_pct * head_dim`` lanes rotate in the half-split (rotate-half)
+pairing, the rest pass through — parallel residual by default (attention
+reads ``input_layernorm``, the MLP reads ``post_attention_layernorm`` of
+the SAME input), exact GELU, and an untied bias-free ``embed_out`` head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.bloom import split_fused_qkv_per_head
+from deepspeed_tpu.models.llama import (
+    apply_rotary,
+    cross_entropy_loss,
+    rotary_embedding,
+)
+from deepspeed_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 6144
+    intermediate_size: int = 24576
+    num_hidden_layers: int = 44
+    num_attention_heads: int = 64
+    rotary_pct: float = 0.25
+    rope_theta: float = 10000.0
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    use_parallel_residual: bool = True
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def rotary_ndims(self) -> int:
+        return int(self.head_dim * self.rotary_pct)
+
+    @staticmethod
+    def tiny(**kw) -> "GPTNeoXConfig":
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    rotary_pct=0.5, max_position_embeddings=128)
+        base.update(kw)
+        return GPTNeoXConfig(**base)
+
+
+class GPTNeoXAttention(nn.Module):
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, ln, positions):
+        cfg = self.config
+        h, d, r = cfg.num_attention_heads, cfg.head_dim, cfg.rotary_ndims
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        qkv = dense(3 * cfg.hidden_size, "query_key_value")(ln)
+        q, k, v = split_fused_qkv_per_head(qkv, h, d)
+        cos, sin = rotary_embedding(positions, r, cfg.rope_theta)
+        q = jnp.concatenate(
+            [apply_rotary(q[..., :r], cos, sin), q[..., r:]], axis=-1)
+        k = jnp.concatenate(
+            [apply_rotary(k[..., :r], cos, sin), k[..., r:]], axis=-1)
+        out = dot_product_attention(q, k, v, causal=True)
+        return dense(cfg.hidden_size, "dense")(
+            out.reshape(*ln.shape[:2], h * d))
+
+
+class GPTNeoXMLP(nn.Module):
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, ln):
+        cfg = self.config
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        return dense(cfg.hidden_size, "dense_4h_to_h")(
+            nn.gelu(dense(cfg.intermediate_size, "dense_h_to_4h")(ln),
+                    approximate=False))
+
+
+class GPTNeoXBlock(nn.Module):
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        norm = lambda name: nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name=name)
+        ln1 = norm("input_layernorm")(x).astype(cfg.dtype)
+        attn = GPTNeoXAttention(cfg, name="attention")(ln1, positions)
+        if cfg.use_parallel_residual:
+            ln2 = norm("post_attention_layernorm")(x).astype(cfg.dtype)
+            return x + attn + GPTNeoXMLP(cfg, name="mlp")(ln2)
+        x = x + attn
+        ln2 = norm("post_attention_layernorm")(x).astype(cfg.dtype)
+        return x + GPTNeoXMLP(cfg, name="mlp")(ln2)
+
+
+class GPTNeoXForCausalLM(nn.Module):
+    config: GPTNeoXConfig
+
+    @property
+    def partition_rules(self):
+        from deepspeed_tpu.module_inject.replace_policy import policy_for
+
+        return policy_for("gptneox")
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.config
+        b, s = input_ids.shape
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed_in")(input_ids)
+        positions = jnp.broadcast_to(
+            jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+        block = nn.remat(GPTNeoXBlock) if cfg.remat else GPTNeoXBlock
+        for i in range(cfg.num_hidden_layers):
+            x = block(cfg, name=f"layers_{i}")(x, positions)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="final_layer_norm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                          param_dtype=jnp.float32,
+                          name="embed_out")(x.astype(cfg.dtype))
+        if labels is not None:
+            return cross_entropy_loss(logits, labels)
+        return logits

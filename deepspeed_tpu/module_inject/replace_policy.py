@@ -62,7 +62,11 @@ register_policy("bloom", [
     (r"dense_4h_to_h/kernel", P("model", None)),
     (r".*layernorm.*", P()),
 ])
-register_policy("gptneox", POLICY_REGISTRY["bloom"])
+register_policy("gptneox", POLICY_REGISTRY["bloom"] + [
+    (r"embed_in/embedding", P("model", None)),
+    (r"embed_out/kernel", P(None, "model")),
+])
+register_policy("gpt_neox", POLICY_REGISTRY["gptneox"])
 
 register_policy("gptj", [
     (r"wte/embedding", P("model", None)),

@@ -19,12 +19,17 @@ def dot_product_attention(q, k, v, *, causal: bool = True,
                           mask: Optional[jax.Array] = None,
                           scale: Optional[float] = None,
                           window: Optional[int] = None,
+                          bias: Optional[jax.Array] = None,
                           implementation: str = "auto"):
     """q: [B,Sq,H,D]; k/v: [B,Sk,Hkv,D] (GQA when Hkv < H).
 
     ``window``: Mistral-style causal sliding window — handled natively by
     the flash kernel (out-of-band blocks skipped); the XLA path applies a
-    banded mask."""
+    banded mask.  ``bias``: additive attention bias broadcastable to
+    [B,H,Sq,Sk] (ALiBi, relative-position) — routes to the XLA path."""
+    if bias is not None:
+        return _xla_attention(q, k, v, causal=causal, mask=mask,
+                              scale=scale, window=window, bias=bias)
     if implementation in ("auto", "pallas"):
         try:
             from deepspeed_tpu.ops.flash_attention import (
@@ -41,7 +46,7 @@ def dot_product_attention(q, k, v, *, causal: bool = True,
                           window=window)
 
 
-def _xla_attention(q, k, v, *, causal, mask, scale, window=None):
+def _xla_attention(q, k, v, *, causal, mask, scale, window=None, bias=None):
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
@@ -53,6 +58,8 @@ def _xla_attention(q, k, v, *, causal, mask, scale, window=None):
     # [B,H,Sq,Sk]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     if causal:
         causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         if window is not None:

@@ -341,3 +341,117 @@ def test_v2_opt_rejects_context_past_position_table(tmp_path):
     })
     with pytest.raises(ValueError, match="position table"):
         InferenceEngineV2.from_hf(path, eng_cfg, dtype=jnp.float32)
+
+
+def test_bloom_logits_match_hf(tmp_path):
+    """BLOOM (ALiBi bias, per-head fused qkv interleave, embedding
+    LayerNorm, tanh GELU): our model must reproduce HF logits."""
+    hf_cfg = transformers.BloomConfig(
+        vocab_size=256, hidden_size=64, n_layer=2, n_head=4,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    hf = transformers.BloomForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    arch, cfg, module = model_from_hf(path, dtype=jnp.float32)
+    assert arch == "bloom" and cfg.num_attention_heads == 4
+    params = load_hf_checkpoint(path, dtype=jnp.float32)
+    ids = np.random.default_rng(20).integers(0, 256, size=(2, 11),
+                                             dtype=np.int64)
+    ours = np.asarray(module.apply({"params": params},
+                                   jnp.asarray(ids, jnp.int32)))
+    theirs = _hf_logits(hf, ids)
+    np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=1e-3)
+
+
+def test_bloom_nonpow2_heads_logits_match_hf(tmp_path):
+    """Non-power-of-2 head count exercises the two-series ALiBi slope
+    interleave."""
+    hf_cfg = transformers.BloomConfig(
+        vocab_size=256, hidden_size=96, n_layer=1, n_head=6,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    hf = transformers.BloomForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    _arch, _cfg, module = model_from_hf(path, dtype=jnp.float32)
+    params = load_hf_checkpoint(path, dtype=jnp.float32)
+    ids = np.random.default_rng(21).integers(0, 256, size=(1, 9),
+                                             dtype=np.int64)
+    ours = np.asarray(module.apply({"params": params},
+                                   jnp.asarray(ids, jnp.int32)))
+    theirs = _hf_logits(hf, ids)
+    np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=1e-3)
+
+
+def test_gptj_logits_match_hf(tmp_path):
+    """GPT-J (parallel residual, bias-free attention, INTERLEAVED partial
+    rotary, biased untied lm_head)."""
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, rotary_dim=8,
+        n_positions=128, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    hf = transformers.GPTJForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    arch, cfg, module = model_from_hf(path, dtype=jnp.float32)
+    assert arch == "gptj" and cfg.rotary_dim == 8
+    params = load_hf_checkpoint(path, dtype=jnp.float32)
+    ids = np.random.default_rng(22).integers(0, 256, size=(2, 13),
+                                             dtype=np.int64)
+    ours = np.asarray(module.apply({"params": params},
+                                   jnp.asarray(ids, jnp.int32)))
+    theirs = _hf_logits(hf, ids)
+    np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=1e-3)
+
+
+@pytest.mark.parametrize("parallel", [True, False])
+def test_gptneox_logits_match_hf(tmp_path, parallel):
+    """GPT-NeoX (per-head fused qkv, partial half-split rotary, parallel
+    and sequential residual variants, untied embed_out)."""
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, rotary_pct=0.5,
+        max_position_embeddings=128, use_parallel_residual=parallel,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    hf = transformers.GPTNeoXForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    arch, cfg, module = model_from_hf(path, dtype=jnp.float32)
+    assert arch in ("gpt_neox", "gptneox")
+    assert cfg.rotary_ndims == 8 and cfg.use_parallel_residual == parallel
+    params = load_hf_checkpoint(path, dtype=jnp.float32)
+    ids = np.random.default_rng(23).integers(0, 256, size=(2, 10),
+                                             dtype=np.int64)
+    ours = np.asarray(module.apply({"params": params},
+                                   jnp.asarray(ids, jnp.int32)))
+    theirs = _hf_logits(hf, ids)
+    np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=1e-3)
+
+
+def test_bert_hidden_states_match_hf(tmp_path):
+    """BERT encoder (post-norm residuals, token-type + learned positions,
+    tanh pooler): last_hidden_state AND pooler_output must match HF."""
+    hf_cfg = transformers.BertConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=128, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    hf = transformers.BertModel(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    arch, cfg, module = model_from_hf(path, dtype=jnp.float32)
+    assert arch == "bert"
+    params = load_hf_checkpoint(path, dtype=jnp.float32)
+    rng = np.random.default_rng(24)
+    ids = rng.integers(0, 256, size=(2, 12), dtype=np.int64)
+    type_ids = rng.integers(0, 2, size=(2, 12), dtype=np.int64)
+    hidden, pooled = module.apply(
+        {"params": params}, jnp.asarray(ids, jnp.int32),
+        jnp.asarray(type_ids, jnp.int32))
+    with torch.no_grad():
+        out = hf(torch.from_numpy(ids),
+                 token_type_ids=torch.from_numpy(type_ids))
+    np.testing.assert_allclose(np.asarray(hidden),
+                               out.last_hidden_state.numpy(),
+                               atol=ATOL, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(pooled),
+                               out.pooler_output.numpy(),
+                               atol=ATOL, rtol=1e-3)
