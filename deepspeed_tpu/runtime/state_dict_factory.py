@@ -4,17 +4,115 @@ merge N tensor-parallel checkpoint shards into M, splitting or
 concatenating each weight along its TP dim).
 
 TPU form: checkpoints are pytrees; a merge/split plan is a tree of
-``axis`` ints (None = replicated — validated identical across shards).
-The inference engine's AutoTP path and universal checkpoint reshape reuse
-these primitives.
+per-leaf entries — ``None`` (replicated, validated identical across
+shards), an ``axis`` int, or ``("qkv", axis)`` for fused QKV projections
+whose shards interleave three blocks (merging those naively along the
+axis would produce ``[q0 k0 v0 q1 k1 v1]`` instead of
+``[q0 q1 | k0 k1 | v0 v1]``; the reference auto-categorizes exactly this
+case, state_dict_factory.py:427 ``merge_query_key_value``).
+
+The plan can be DERIVED from the architecture's TP policy with
+:func:`axes_from_policy` — the same ``(regex, PartitionSpec)`` registry
+the engine/AutoTP/inference stack shards with (the position of the
+``'model'`` axis in a leaf's PartitionSpec *is* its merge/split axis) —
+so callers never hand-author an axis tree.  The inference engine's
+AutoTP path and universal checkpoint reshape reuse these primitives.
 """
 
 from __future__ import annotations
 
+import re
 from typing import Any, Callable, List, Optional
 
 import jax
 import numpy as np
+
+# Fused attention projections that pack [q-block|k-block|v-block] along
+# their TP axis (Megatron/GPT-2 convention) — those need the interleaved
+# merge; reference state_dict_factory.py:427 keys off module names the
+# same way.  NOTE: ``query_key_value`` (BLOOM/GPT-NeoX/Falcon) is
+# deliberately NOT here — that family fuses per-head ``[h, 3, d]``, where
+# heads are contiguous along the axis and a plain contiguous slice is the
+# correct TP split.
+QKV_FUSED_PATTERN = re.compile(r"(c_attn|qkv_proj|w_qkv)")
+
+
+def _model_axis(spec: Any) -> Optional[int]:
+    """Position of the 'model' mesh axis in a PartitionSpec (= the TP
+    merge/split dim), or None when the leaf is replicated over TP."""
+    for i, entry in enumerate(tuple(spec)):
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if "model" in [n for n in names if n]:
+            return i
+    return None
+
+
+def axes_from_policy(policy: Any, tree: Any) -> Any:
+    """Build a merge/split plan for ``tree`` from a TP policy.
+
+    ``policy`` is an architecture name (resolved via
+    :func:`deepspeed_tpu.module_inject.replace_policy.policy_for`) or a
+    rules list ``[(regex, PartitionSpec), ...]``.  Each leaf's '/'-joined
+    path is matched against the rules: the matched spec's 'model' axis
+    position becomes the merge axis; fused-QKV names get the
+    ``("qkv", axis)`` interleave category; unmatched or replicated
+    leaves get ``None``.
+    """
+    if isinstance(policy, str):
+        from deepspeed_tpu.module_inject.replace_policy import policy_for
+
+        rules = policy_for(policy)
+        if rules is None:
+            raise ValueError(f"no TP policy registered for {policy!r}")
+    else:
+        rules = policy
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    entries = []           # (parts, leaf, entry)
+    kernel_entry = {}      # parent path -> (kernel entry, kernel ndim)
+    for key_path, leaf in flat:
+        parts = [str(getattr(k, "key", getattr(k, "idx", k)))
+                 for k in key_path]
+        name = "/".join(parts)
+        axis = None
+        for pat, spec in compiled:
+            if pat.search(name):
+                axis = _model_axis(spec)
+                break
+        entry: Any = axis
+        if axis is not None and QKV_FUSED_PATTERN.search(name):
+            entry = ("qkv", axis)
+        entries.append((parts, leaf, entry))
+        if parts[-1] == "kernel":
+            kernel_entry[tuple(parts[:-1])] = (entry, np.ndim(leaf))
+
+    plan: dict = {}
+    for parts, leaf, entry in entries:
+        # Policies only carry */kernel rules; a column-parallel layer's
+        # bias is sliced with its kernel's output dim (Megatron), a
+        # row-parallel layer's bias is replicated.  Derive the bias entry
+        # from the sibling kernel (reference containers do the same
+        # classification per-module).
+        if entry is None and parts[-1] == "bias" and np.ndim(leaf) == 1:
+            sib = kernel_entry.get(tuple(parts[:-1]))
+            if sib is not None:
+                k_entry, k_ndim = sib
+                k_axis = k_entry[1] if isinstance(k_entry, tuple) \
+                    else k_entry
+                if k_axis is not None and k_axis == k_ndim - 1:
+                    entry = ("qkv", 0) if isinstance(k_entry, tuple) \
+                        else 0
+        node = plan
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = entry
+    return plan
+
+
+def _is_plan_leaf(x: Any) -> bool:
+    return x is None or isinstance(x, int) or (
+        isinstance(x, tuple) and len(x) == 2 and x[0] == "qkv")
 
 
 class SDLoaderFactory:
@@ -22,6 +120,15 @@ class SDLoaderFactory:
     def get_sd_loader_json(trees: List[Any], merge_axes: Any
                            ) -> "MegatronSDLoader":
         return MegatronSDLoader(trees, merge_axes)
+
+    @staticmethod
+    def get_sd_loader(trees: List[Any], architecture: str
+                      ) -> "MegatronSDLoader":
+        """Auto mode: derive the merge/split plan from the registered TP
+        policy for ``architecture`` (reference auto-categorization,
+        state_dict_factory.py:427)."""
+        return MegatronSDLoader(
+            trees, axes_from_policy(architecture, trees[0]))
 
 
 class MegatronSDLoader:
@@ -35,37 +142,62 @@ class MegatronSDLoader:
         self.merge_axes = merge_axes
 
     def merge_state_dict(self) -> Any:
-        """N shards -> 1 full tree: concat along each leaf's TP axis."""
-        def one(axis, *leaves):
-            if axis is None:
+        """N shards -> 1 full tree: concat along each leaf's TP axis.
+
+        ``("qkv", axis)`` leaves de-interleave: every shard carries
+        ``[q_r|k_r|v_r]`` along the axis, so each third is concatenated
+        across shards first, then the thirds re-joined — reference
+        ``merge_query_key_value`` (state_dict_factory.py:427)."""
+        def one(entry, *leaves):
+            if entry is None:
                 first = np.asarray(leaves[0])
                 for other in leaves[1:]:
                     if not np.array_equal(first, np.asarray(other)):
                         raise ValueError(
                             "replicated leaf differs across shards")
                 return leaves[0]
+            if isinstance(entry, tuple):
+                _, axis = entry
+                chunks = [np.split(np.asarray(l), 3, axis=axis)
+                          for l in leaves]
+                return np.concatenate(
+                    [np.concatenate([c[i] for c in chunks], axis=axis)
+                     for i in range(3)], axis=axis)
             return np.concatenate([np.asarray(l) for l in leaves],
-                                  axis=axis)
+                                  axis=entry)
 
         return jax.tree.map(one, self.merge_axes, *self.trees,
-                            is_leaf=lambda x: x is None)
+                            is_leaf=_is_plan_leaf)
 
     def split_state_dict(self, num_shards: int) -> List[Any]:
-        """1 (merged) tree -> M shards along the same axes."""
+        """1 (merged) tree -> M shards along the same axes.  ``("qkv",
+        axis)`` leaves re-interleave so each shard gets its own
+        ``[q_r|k_r|v_r]`` block (reference ``split_query_key_value``)."""
         full = self.merge_state_dict() if len(self.trees) > 1 \
             else self.trees[0]
 
-        def split_leaf(axis, leaf):
-            if axis is None:
+        def split_leaf(entry, leaf):
+            if entry is None:
                 return [leaf] * num_shards
+            axis = entry[1] if isinstance(entry, tuple) else entry
             if leaf.shape[axis] % num_shards != 0:
                 raise ValueError(
                     f"dim {axis} of {leaf.shape} not divisible by "
                     f"{num_shards}")
+            if isinstance(entry, tuple):
+                if leaf.shape[axis] % (3 * num_shards) != 0:
+                    raise ValueError(
+                        f"fused qkv dim {axis} of {leaf.shape} not "
+                        f"divisible by 3*{num_shards}")
+                thirds = [np.split(t, num_shards, axis=axis)
+                          for t in np.split(np.asarray(leaf), 3,
+                                            axis=axis)]
+                return [np.concatenate([t[r] for t in thirds], axis=axis)
+                        for r in range(num_shards)]
             return np.split(np.asarray(leaf), num_shards, axis=axis)
 
         pieces = jax.tree.map(split_leaf, self.merge_axes, full,
-                              is_leaf=lambda x: x is None)
+                              is_leaf=_is_plan_leaf)
         out = []
         for r in range(num_shards):
             out.append(jax.tree.map(

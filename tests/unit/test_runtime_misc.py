@@ -172,3 +172,104 @@ def test_replace_policy_registry():
 
 def test_ring_attention_exported():
     from deepspeed_tpu.sequence import DistributedRingAttention, ring_attention  # noqa: F401
+
+
+def test_state_dict_factory_auto_policy_roundtrip():
+    """Auto mode (reference state_dict_factory.py:427 auto-categorization):
+    the merge/split plan derives from the registered TP policy — fused
+    qkv interleaved per shard, column/row kernels split on the 'model'
+    axis position, norms replicated — and split->merge round-trips
+    bitwise."""
+    from deepspeed_tpu.runtime.state_dict_factory import axes_from_policy
+
+    rng = np.random.default_rng(3)
+    h = 8
+    q = rng.normal(size=(16, h)).astype(np.float32)
+    k = rng.normal(size=(16, h)).astype(np.float32)
+    v = rng.normal(size=(16, h)).astype(np.float32)
+    qb, kb, vb = (rng.normal(size=(h,)).astype(np.float32)
+                  for _ in range(3))
+    tree = {
+        "h_0": {
+            "c_attn": {"kernel": np.concatenate([q, k, v], axis=1),
+                       "bias": np.concatenate([qb, kb, vb])},
+            "attn_out": {"kernel": rng.normal(size=(h, 16))
+                         .astype(np.float32)},
+            "ln_1": {"scale": np.ones(16, np.float32)},
+        },
+        "wte": {"embedding": rng.normal(size=(32, 16)).astype(np.float32)},
+    }
+    plan = axes_from_policy("gpt2", tree)
+    assert plan["h_0"]["c_attn"]["kernel"] == ("qkv", 1)
+    # column-parallel bias is sliced with the kernel's output dim, and
+    # inherits the qkv interleave
+    assert plan["h_0"]["c_attn"]["bias"] == ("qkv", 0)
+    assert plan["h_0"]["attn_out"]["kernel"] == 0
+    assert plan["h_0"]["ln_1"]["scale"] is None
+    assert plan["wte"]["embedding"] == 0
+
+    loader = SDLoaderFactory.get_sd_loader([tree], "gpt2")
+    shards = loader.split_state_dict(2)
+    # each shard's fused qkv must be [q_r | k_r | v_r], NOT a contiguous
+    # slice of the fused tensor
+    half = h // 2
+    np.testing.assert_array_equal(
+        shards[0]["h_0"]["c_attn"]["kernel"],
+        np.concatenate([q[:, :half], k[:, :half], v[:, :half]], axis=1))
+    np.testing.assert_array_equal(
+        shards[1]["h_0"]["c_attn"]["kernel"],
+        np.concatenate([q[:, half:], k[:, half:], v[:, half:]], axis=1))
+    np.testing.assert_array_equal(
+        shards[0]["h_0"]["c_attn"]["bias"],
+        np.concatenate([qb[:half], kb[:half], vb[:half]]))
+    # row-parallel kernel splits on axis 0; norm replicated
+    assert shards[0]["h_0"]["attn_out"]["kernel"].shape == (4, 16)
+    np.testing.assert_array_equal(shards[1]["h_0"]["ln_1"]["scale"],
+                                  tree["h_0"]["ln_1"]["scale"])
+
+    merged = SDLoaderFactory.get_sd_loader(shards, "gpt2") \
+        .merge_state_dict()
+    for path, leaf in [(("h_0", "c_attn", "kernel"), None),
+                       (("h_0", "c_attn", "bias"), None),
+                       (("h_0", "attn_out", "kernel"), None),
+                       (("wte", "embedding"), None)]:
+        a, b = merged, tree
+        for p in path:
+            a, b = a[p], b[p]
+        np.testing.assert_array_equal(a, b)
+
+
+def test_state_dict_factory_auto_llama_no_qkv_fusion():
+    """Separate q/k/v projections (llama) categorize as plain column
+    splits — the qkv interleave only triggers on fused names."""
+    from deepspeed_tpu.runtime.state_dict_factory import axes_from_policy
+
+    tree = {"layers_0": {"self_attn": {
+        "q_proj": {"kernel": np.zeros((8, 8), np.float32)},
+        "o_proj": {"kernel": np.zeros((8, 8), np.float32)}}}}
+    plan = axes_from_policy("llama", tree)
+    assert plan["layers_0"]["self_attn"]["q_proj"]["kernel"] == 1
+    assert plan["layers_0"]["self_attn"]["o_proj"]["kernel"] == 0
+
+
+def test_state_dict_factory_per_head_qkv_is_contiguous_slice():
+    """BLOOM/GPT-NeoX fuse qkv per-head ([h, 3, d] along the output dim):
+    heads are contiguous there, so the correct TP split is a PLAIN slice
+    — the Megatron [q|k|v] de-interleave must not trigger."""
+    from deepspeed_tpu.runtime.state_dict_factory import axes_from_policy
+
+    rng = np.random.default_rng(4)
+    hid, heads, d = 8, 4, 2
+    kern = rng.normal(size=(hid, heads * 3 * d)).astype(np.float32)
+    tree = {"h_0": {"self_attention": {
+        "query_key_value": {"kernel": kern,
+                            "bias": rng.normal(size=(heads * 3 * d,))
+                            .astype(np.float32)}}}}
+    plan = axes_from_policy("bloom", tree)
+    assert plan["h_0"]["self_attention"]["query_key_value"]["kernel"] == 1
+    assert plan["h_0"]["self_attention"]["query_key_value"]["bias"] == 0
+    shards = SDLoaderFactory.get_sd_loader([tree], "bloom") \
+        .split_state_dict(2)
+    np.testing.assert_array_equal(
+        shards[0]["h_0"]["self_attention"]["query_key_value"]["kernel"],
+        kern[:, :heads * 3 * d // 2])
