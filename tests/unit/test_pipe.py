@@ -525,3 +525,70 @@ def test_pipeline_1f1b_raw_gradients_match_gpipe():
         np.testing.assert_allclose(a, b, rtol=1e-6)
     for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_f1b)):
         np.testing.assert_allclose(b, a, rtol=2e-5, atol=1e-7)
+
+
+def test_pipeline_1f1b_depth_parity_s8_m16():
+    """VERDICT r4 #5: parity beyond toy widths — the full 8-device pipe
+    (S=8) with M=16 microbatches (46-tick TrainSchedule) must train
+    identically to gpipe from the same initial params."""
+    cfg = {**CFG, "gradient_accumulation_steps": 16}
+    topo = groups.initialize_mesh(pipe_parallel_size=8,
+                                  data_parallel_size=1)
+    module = make_module(n_blocks=8)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=module, config=cfg,
+                                            topology=topo,
+                                            pipe_schedule="gpipe")
+    batches = make_batches(16, 4, 8, seed=7)
+    stacked0 = tuple(np.stack([np.asarray(mb[i]) for mb in batches])
+                     for i in range(2))
+    eng.initialize_parameters(*stacked0)
+    params0 = jax.device_get(eng.state["master"])
+    gpipe_losses = _train(eng, 2, batches)
+
+    groups.reset()
+    topo2 = groups.initialize_mesh(pipe_parallel_size=8,
+                                   data_parallel_size=1)
+    eng2, _, _, _ = deepspeed_tpu.initialize(
+        model=make_module(n_blocks=8), config=cfg, topology=topo2,
+        model_parameters=params0, pipe_schedule="1f1b")
+    f1b_losses = _train(eng2, 2, batches)
+    np.testing.assert_allclose(f1b_losses, gpipe_losses, rtol=2e-5)
+
+
+def test_pipeline_default_schedule_is_1f1b():
+    topo = groups.initialize_mesh(pipe_parallel_size=2,
+                                  data_parallel_size=4)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=make_module(n_blocks=4), config=dict(CFG), topology=topo)
+    assert eng._pipe_schedule == "1f1b"
+
+
+def test_pipeline_1f1b_memory_at_depth():
+    """VERDICT r4 #5: the memory story at a 24-layer model — 1f1b's
+    compiled program must need LESS temp memory than gpipe's at the same
+    depth/microbatch count (the rotating NB-slot buffer + in-tick VJP vs
+    one saved activation per tick plus the autodiff residual chain)."""
+    def temp_bytes(schedule):
+        groups.reset()
+        topo = groups.initialize_mesh(pipe_parallel_size=4,
+                                      data_parallel_size=2)
+        cfg = {**CFG, "gradient_accumulation_steps": 8}
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=make_module(n_blocks=24), config=cfg, topology=topo,
+            pipe_schedule=schedule)
+        batches = make_batches(8, 8, 8)
+        stacked = tuple(np.stack([np.asarray(mb[i]) for mb in batches])
+                        for i in range(2))
+        eng.initialize_parameters(*stacked)
+        stacked_s = eng.shard_batch(stacked)
+
+        def loss_fn(params, xs, ys):
+            return eng._pipe_apply(params, xs, ys)
+
+        lowered = jax.jit(jax.grad(loss_fn)).lower(
+            eng.state["params"], *stacked_s)
+        return lowered.compile().memory_analysis().temp_size_in_bytes
+
+    g = temp_bytes("gpipe")
+    f = temp_bytes("1f1b")
+    assert f < g, (f, g)
