@@ -1,0 +1,7 @@
+"""Inference surface: v1 engine (deepspeed_tpu.init_inference), FastGen
+v2 (:mod:`deepspeed_tpu.inference.v2`), and the diffusion pipeline
+(:mod:`deepspeed_tpu.inference.diffusion`)."""
+
+from deepspeed_tpu.inference.diffusion import DiffusionPipeline
+
+__all__ = ["DiffusionPipeline"]
