@@ -96,7 +96,7 @@ def _rms_norm(x, scale, eps):
 
 def _paged_attention(q, k_pool, v_pool, batch, block_size,
                      use_kernel=None, window=None, prefill_tile=None,
-                     decode_mode=False):
+                     decode_mode=False, force_dense=None):
     """Paged attention over the blocked KV pool.
 
     q: [T, H, D]; k_pool/v_pool: [num_blocks*bs, Hkv, D].
@@ -111,39 +111,52 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
     of (tokens, blocks), the reference's atom_builder work-unit shape.
 
     ``decode_mode`` (static; engine decode programs set it) asserts
-    T == S with ``token_slot == arange(S)`` and takes the XLA gather path
-    with the per-token slot gather elided and gathers kept in bf16: at
-    decode shapes (a handful of single tokens, a few KV blocks each) the
-    per-grid-step overhead of the Pallas kernel exceeds the whole gather's
-    HBM traffic (measured ~1.7 vs ~0.7 ms/step for 12 layers of a
-    125M-GQA model on v5e), so the gather composition is the faster
-    program — the opposite of the prefill regime.
+    T == S with ``token_slot == arange(S)``.  On TPU it routes to the
+    O(live-context) manual-DMA decode kernel
+    (:func:`deepspeed_tpu.inference.v2.kernels.paged_decode_attention`)
+    — per-sequence dynamic walk over live block-table entries with
+    double-buffered HBM block DMAs, so the read volume is Σ live-context
+    bytes rather than O(pool) (the round-4 dense default, which becomes
+    the dominant cost at 7B-scale pools) or O(S x table-width).
+    ``force_dense`` (tools/profile_decode_attn.py) pins the XLA
+    dense/gather fallbacks for comparison.
 
     The plain XLA gather composition below is the reference/CPU path.
     """
     if use_kernel is None:
         try:
-            use_kernel = (not decode_mode
-                          and jax.devices()[0].platform == "tpu")
+            use_kernel = jax.devices()[0].platform == "tpu"
         except Exception:  # noqa: BLE001
             use_kernel = False
-    if use_kernel:
+    if use_kernel and force_dense is None:
         from deepspeed_tpu.inference.v2.kernels import (
             paged_attention, paged_attention_usable,
-            paged_prefill_attention)
+            paged_decode_attention, paged_prefill_attention)
 
         if paged_attention_usable(q, k_pool, block_size):
             w = int(window) if window is not None else None
-            if prefill_tile and q.shape[0] % prefill_tile == 0:
+            if decode_mode:
+                # the manual-DMA kernel copies [bs, Hkv, D] pool blocks,
+                # whose lane dim D must be 128-aligned; small-head_dim
+                # serving geometries (125M-class D=64) take the XLA
+                # dense/gather decode below instead — measured FASTER
+                # there anyway (tools/profile_decode_attn.py crossover)
+                if q.shape[-1] % 128 == 0:
+                    return paged_decode_attention(
+                        q, k_pool, v_pool, batch["block_tables"],
+                        batch["token_slot"], batch["token_pos"],
+                        block_size=block_size, window=w)
+            elif prefill_tile and q.shape[0] % prefill_tile == 0:
                 return paged_prefill_attention(
                     q, k_pool, v_pool, batch["block_tables"],
                     batch["token_slot"], batch["token_pos"],
                     block_size=block_size, tile_q=int(prefill_tile),
                     window=w)
-            return paged_attention(
-                q, k_pool, v_pool, batch["block_tables"],
-                batch["token_slot"], batch["token_pos"],
-                block_size=block_size, window=w)
+            else:
+                return paged_attention(
+                    q, k_pool, v_pool, batch["block_tables"],
+                    batch["token_slot"], batch["token_pos"],
+                    block_size=block_size, window=w)
     block_tables = batch["block_tables"]          # [S, B]
     token_slot = batch["token_slot"]              # [T]
     token_pos = batch["token_pos"]                # [T]
@@ -153,7 +166,8 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
     hkv = k_pool.shape[1]
     group = h // hkv
 
-    if decode_mode and k_pool.shape[0] <= 2 * S * C:
+    if decode_mode and (force_dense if force_dense is not None
+                        else k_pool.shape[0] <= 2 * S * C):
         # Masked DENSE attention over the whole pool: when the engine
         # sizes the pool close to max_seqs * max_context (the serving-
         # dense case), the live contexts cover most of it, so reading
@@ -331,18 +345,16 @@ class RaggedLlama:
         if self.tp == 1:
             return self._forward(params, kv_cache, batch, ax=None,
                                  prefill_tile=prefill_tile, decode=decode)
-        from jax.experimental.shard_map import shard_map
-
         param_specs = ragged_param_specs(params)
         cache_specs = jax.tree.map(lambda _x: KV_SPEC, kv_cache)
         batch_specs = jax.tree.map(lambda _x: P(), batch)
         fwd = functools.partial(self._forward, ax=self.tp_axis,
                                 prefill_tile=prefill_tile, decode=decode)
-        return shard_map(
+        return jax.shard_map(
             fwd, mesh=self.mesh,
             in_specs=(param_specs, cache_specs, batch_specs),
             out_specs=(P(), cache_specs),
-            check_rep=False,
+            check_vma=False,
         )(params, kv_cache, batch)
 
     # ------------------------------------------------------------------ #
