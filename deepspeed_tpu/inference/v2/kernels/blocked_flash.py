@@ -278,9 +278,14 @@ def _prefill_kernel(tile_slot, tile_maxpos, tables, q_ref, pos_ref, k_ref,
         if window is not None:
             keep = jnp.logical_and(keep, key_pos > pos - window)
         for h in range(num_heads):
-            q = q_ref[:, h, :]                        # [tile_q, d]
-            kb = k_ref[0, :, h // g, :]               # [bs, d]
-            vb = v_ref[0, :, h // g, :]
+            # flattened-lane per-head slices (static offsets): a 4D
+            # [:, h, :] access needs a 2D<->3D vector reshape that
+            # Mosaic's infer-vector-layout rejects at some (tile, d)
+            # combos ("unsupported shape cast")
+            d = q_ref.shape[1] // num_heads
+            q = q_ref[:, h * d:(h + 1) * d]           # [tile_q, d]
+            kb = k_ref[0][:, (h // g) * d:(h // g + 1) * d]   # [bs, d]
+            vb = v_ref[0][:, (h // g) * d:(h // g + 1) * d]
             s = jax.lax.dot_general(
                 q, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
@@ -301,10 +306,12 @@ def _prefill_kernel(tile_slot, tile_maxpos, tables, q_ref, pos_ref, k_ref,
 
     @pl.when(j == num_blocks_per_seq - 1)
     def _():
+        d = q_ref.shape[1] // num_heads
         for h in range(num_heads):
             l = l_ref[h, :, :1]
             safe_l = jnp.where(l == 0.0, 1.0, l)
-            o_ref[:, h, :] = (acc_ref[h] / safe_l).astype(o_ref.dtype)
+            o_ref[:, h * d:(h + 1) * d] = (acc_ref[h]
+                                           / safe_l).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit,
@@ -332,8 +339,11 @@ def paged_prefill_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
 
         interpret = not _on_tpu()
 
-    kp = k_pool.reshape(nb, block_size, hkv, d)
-    vp = v_pool.reshape(nb, block_size, hkv, d)
+    # flattened-lane layouts (see _prefill_kernel): q/o [T, H*D], pools
+    # [nb, bs, Hkv*D]
+    qf = q.reshape(t_count, h * d)
+    kp = k_pool.reshape(nb, block_size, hkv * d)
+    vp = v_pool.reshape(nb, block_size, hkv * d)
     scale = 1.0 / (d ** 0.5)
 
     # per-tile metadata (XLA-land, cheap): the stripe's slot + max position
@@ -349,21 +359,21 @@ def paged_prefill_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                 (maxpos[t] - tile_q - window + 1) // block_size, 0)
             jj = jnp.maximum(jj, jnp.minimum(
                 lo, jnp.maximum(maxpos[t], 0) // block_size))
-        return (tab[slot[t], jj], 0, 0, 0)
+        return (tab[slot[t], jj], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(nt, b_per_seq),
         in_specs=[
-            pl.BlockSpec((tile_q, h, d),
-                         lambda t, j, slot, maxpos, tab: (t, 0, 0)),
+            pl.BlockSpec((tile_q, h * d),
+                         lambda t, j, slot, maxpos, tab: (t, 0)),
             pl.BlockSpec((tile_q, 8),
                          lambda t, j, slot, maxpos, tab: (t, 0)),
-            pl.BlockSpec((1, block_size, hkv, d), _kv_index),
-            pl.BlockSpec((1, block_size, hkv, d), _kv_index),
+            pl.BlockSpec((1, block_size, hkv * d), _kv_index),
+            pl.BlockSpec((1, block_size, hkv * d), _kv_index),
         ],
-        out_specs=pl.BlockSpec((tile_q, h, d),
-                               lambda t, j, slot, maxpos, tab: (t, 0, 0)),
+        out_specs=pl.BlockSpec((tile_q, h * d),
+                               lambda t, j, slot, maxpos, tab: (t, 0)),
         scratch_shapes=[
             pltpu.VMEM((h, tile_q, d), jnp.float32),
             pltpu.VMEM((h, tile_q, 128), jnp.float32),
@@ -374,12 +384,13 @@ def paged_prefill_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         _prefill_kernel, block_size=block_size,
         num_blocks_per_seq=b_per_seq, scale=scale, tile_q=tile_q,
         num_heads=h, num_kv_heads=hkv, window=window)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((t_count, h, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((t_count, h * d), q.dtype),
         interpret=bool(interpret),
-    )(tile_slot, tile_maxpos, block_tables.astype(jnp.int32), q, pos8,
+    )(tile_slot, tile_maxpos, block_tables.astype(jnp.int32), qf, pos8,
       kp, vp)
+    return out.reshape(t_count, h, d)
 
 
 @functools.partial(jax.jit,

@@ -137,11 +137,16 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
             w = int(window) if window is not None else None
             if decode_mode:
                 # the manual-DMA kernel copies [bs, Hkv, D] pool blocks,
-                # whose lane dim D must be 128-aligned; small-head_dim
-                # serving geometries (125M-class D=64) take the XLA
-                # dense/gather decode below instead — measured FASTER
-                # there anyway (tools/profile_decode_attn.py crossover)
-                if q.shape[-1] % 128 == 0:
+                # whose lane dim D must be 128-aligned, and it wins when
+                # the pool is LARGER than the live contexts (its read is
+                # O(live); the dense path's is O(pool) — crossover table
+                # in tools/profile_decode_attn.py: 4.28 vs 5.77 ms at
+                # pool 512 blk / ctx 2k).  Tight pools (pool ~ live, the
+                # serving-dense case) keep the dense read below, which
+                # measured ~10% faster there.
+                S_, B_ = batch["block_tables"].shape
+                big_pool = k_pool.shape[0] > 2 * S_ * B_ * block_size
+                if q.shape[-1] % 128 == 0 and big_pool:
                     return paged_decode_attention(
                         q, k_pool, v_pool, batch["block_tables"],
                         batch["token_slot"], batch["token_pos"],

@@ -132,9 +132,11 @@ def run_selftest(tol: float = 3e-2) -> dict:
                                token_pos, block_size=bs, interpret=False),
         want2))
 
-    # prefill: tile-aligned tokens for slot 0
+    # prefill: tile-aligned tokens for slot 0, at the ENGINE's shipped
+    # 125M serving geometry (6 q heads / 2 kv heads — the exact kernel
+    # instantiation bench_serving.py runs)
     T = 256
-    qp = jax.random.normal(jax.random.fold_in(key, 9), (T, 8, 64),
+    qp = jax.random.normal(jax.random.fold_in(key, 9), (T, 6, 64),
                            jnp.bfloat16)
     pbatch = {"block_tables": tables,
               "token_slot": jnp.zeros((T,), jnp.int32),
