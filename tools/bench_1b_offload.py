@@ -59,10 +59,10 @@ def main():
         leaf = jax.tree_util.tree_leaves(engine.state["params"])[0]
         return jax.device_get(jnp.ravel(leaf)[0])
 
-    for _ in range(2):
+    for _ in range(1):
         loss = step()
     hard_sync()
-    iters = 5
+    iters = 3
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step()
