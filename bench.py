@@ -222,7 +222,7 @@ def main():
         selftest = {"ok": False, "note": "skipped: bench time budget"}
 
     tpu_geom = None
-    if elapsed() < 470:
+    if elapsed() < 430:
         tok_s2, mfu2, _loss2, step_ms2, _, _ = _measure(
             heads=TPU_HEADS, micro_batch=TPU_MB, seq=seq)
         tpu_geom = {
