@@ -73,7 +73,8 @@ def peak_flops_per_chip() -> float:
     return 197e12  # conservative default (cpu-sim prints are meaningless anyway)
 
 
-def _measure(heads: int, micro_batch: int, seq: int):
+def _measure(heads: int, micro_batch: int, seq: int,
+             attention_layout: str = "bshd"):
     """One training-throughput measurement at the given head geometry.
     Returns (tokens/s/chip, mfu, loss, step_ms, n_params, n_dev)."""
     import jax
@@ -93,6 +94,9 @@ def _measure(heads: int, micro_batch: int, seq: int):
         "zero_optimization": {"stage": 1},
         "bf16": {"enabled": True},
         "gradient_clipping": 1.0,
+        # "folded" = layout-native attention ([B,S,H*D] end to end, no
+        # BSHD<->BHSD transposes) — exercises the runtime-config plumbing
+        "attention_layout": attention_layout,
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=LlamaForCausalLM(cfg_m),
                                                config=ds_config)
@@ -201,8 +205,15 @@ def main():
     # throughput-optimal micro-batch — reported separately, NOT in the
     # headline, so geometry changes can never inflate vs_baseline.
     TPU_HEADS, TPU_MB = 6, 16
+    # Headline attention layout: DS_ATTENTION_LAYOUT=folded routes the
+    # honest geometry through the layout-native kernels; default "bshd"
+    # keeps the headline exactly comparable to prior rounds.
+    import os
+
+    headline_layout = os.environ.get("DS_ATTENTION_LAYOUT", "bshd")
     tok_s, mfu, loss, step_ms, n_params, n_dev = _measure(
-        heads=HEADLINE_HEADS, micro_batch=HEADLINE_MB, seq=seq)
+        heads=HEADLINE_HEADS, micro_batch=HEADLINE_MB, seq=seq,
+        attention_layout=headline_layout)
 
     # on-chip Pallas kernel selftest (every kernel vs its jnp reference,
     # compiled — not interpret mode), time-permitting
@@ -233,6 +244,34 @@ def main():
             "step_time_ms": round(step_ms2, 2),
         }
 
+    # A/B for the layout-native path: the honest geometry with the folded
+    # attention layout (same JSON shape as the headline extras), so one
+    # bench run yields the before/after the PERFLOG needs. Runs LAST so
+    # it can never crowd out the long-standing tpu_geometry record, and
+    # guarded: a Mosaic failure in the new kernels must not cost the
+    # headline.
+    folded_geom = None
+    if headline_layout != "folded" and devs[0].platform == "tpu":
+        if elapsed() < 480:
+            try:
+                tok_sf, mfuf, _lossf, step_msf, _, _ = _measure(
+                    heads=HEADLINE_HEADS, micro_batch=HEADLINE_MB, seq=seq,
+                    attention_layout="folded")
+                folded_geom = {
+                    "heads": HEADLINE_HEADS,
+                    "head_dim": 768 // HEADLINE_HEADS,
+                    "micro_batch": HEADLINE_MB,
+                    "tokens_per_sec_per_chip": round(tok_sf, 1),
+                    "mfu": round(mfuf, 4),
+                    "step_time_ms": round(step_msf, 2),
+                }
+            except Exception as e:  # noqa: BLE001
+                folded_geom = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# folded-layout A/B done at {elapsed():.0f}s",
+                  file=sys.stderr)
+        else:
+            folded_geom = {"note": "skipped: bench time budget"}
+
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip_gpt125m",
         "value": round(tok_s, 1),
@@ -247,6 +286,8 @@ def main():
             "heads": HEADLINE_HEADS,
             "head_dim": 768 // HEADLINE_HEADS,
             "micro_batch": HEADLINE_MB,
+            "attention_layout": headline_layout,
+            **({"folded_attention": folded_geom} if folded_geom else {}),
             **({"tpu_geometry": tpu_geom} if tpu_geom else {}),
             "serving_7b": serving_7b,
             "kernel_selftest": selftest,

@@ -104,13 +104,12 @@ def main(args=None) -> int:
     while size <= ns.maxsize:
         for op in ns.ops.split(","):
             dt = _bench_collective(op, size, mesh, axis, ns.iters)
-            # nccl-tests bus-bandwidth convention: allreduce and alltoall
-            # are defined over the per-rank buffer (which `size` is),
-            # allgather/reducescatter over the TOTAL gathered buffer —
-            # those scale by world before the ring factor
+            # nccl-tests bus-bandwidth convention; `size` is the PER-RANK
+            # buffer throughout, so allgather/reducescatter's total-buffer
+            # ring factor world*(world-1)/world reduces to (world-1)
             factor = {"allreduce": 2 * (world - 1) / world,
-                      "allgather": world * (world - 1) / world,
-                      "reducescatter": world * (world - 1) / world,
+                      "allgather": world - 1,
+                      "reducescatter": world - 1,
                       "alltoall": (world - 1) / world}[op]
             bw = size * factor / dt / 1e9
             print(f"{op:<14}{size:>12}{dt * 1e3:>10.3f}ms{bw:>12.2f}")

@@ -437,6 +437,9 @@ def config_from_hf(model_path: str, dtype: Any = None):
             num_attention_heads=cfg["n_head"],
             max_position_embeddings=cfg["n_positions"],
             layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-5),
+            # HF n_inner (null in most checkpoints -> 4*n_embd), same
+            # shape-error fix as the gptj branch below
+            intermediate_size=cfg.get("n_inner") or 4 * cfg["n_embd"],
             dtype=dt)
     if arch == "opt":
         from deepspeed_tpu.models.opt import OPTConfig
@@ -504,6 +507,9 @@ def config_from_hf(model_path: str, dtype: Any = None):
             cfg["n_head"],
             max_position_embeddings=cfg["n_positions"],
             layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-5),
+            # HF n_inner (null in most checkpoints -> 4*n_embd); without
+            # this, non-default-n_inner checkpoints shape-error on fc_in
+            intermediate_size=cfg.get("n_inner") or 4 * cfg["n_embd"],
             dtype=dt)
     if arch in ("gpt_neox", "gptneox"):
         from deepspeed_tpu.models.gptneox import GPTNeoXConfig

@@ -30,6 +30,17 @@ def ddim_schedule(num_train_timesteps: int = 1000,
     return jnp.cumprod(1.0 - betas)
 
 
+def ddim_timesteps(num_train_timesteps: int, steps: int,
+                   steps_offset: int = 0) -> np.ndarray:
+    """Descending DDIM timestep subset, diffusers' default "leading"
+    spacing: ``arange(steps) * (T // steps) + steps_offset``, reversed —
+    so outputs match diffusers numerically for the same checkpoint.
+    Stable-Diffusion scheduler configs ship ``steps_offset=1``."""
+    ratio = num_train_timesteps // steps
+    return (np.arange(steps, dtype=np.int64) * ratio + steps_offset)[::-1] \
+        .astype(np.int32).copy()
+
+
 class DiffusionPipeline:
     """text ids -> image, stable-diffusion style.
 
@@ -42,9 +53,15 @@ class DiffusionPipeline:
     def __init__(self, unet, unet_params, vae, vae_params,
                  text_encoder, text_params,
                  num_train_timesteps: int = 1000,
+                 steps_offset: int = 1,
                  mesh: Optional[Any] = None):
         self.unet, self.vae, self.text_encoder = unet, vae, text_encoder
         self.alphas_cumprod = ddim_schedule(num_train_timesteps)
+        # diffusers DDIMScheduler as configured by SD checkpoints:
+        # set_alpha_to_one=False (final step denoises toward
+        # alphas_cumprod[0], not alpha=1) and steps_offset=1
+        self.final_alpha_cumprod = self.alphas_cumprod[0]
+        self.steps_offset = steps_offset
         self.num_train_timesteps = num_train_timesteps
         self.mesh = mesh
         if mesh is not None:
@@ -84,10 +101,10 @@ class DiffusionPipeline:
         uncond_ids = jnp.asarray(uncond_ids, jnp.int32)
         b = prompt_ids.shape[0]
         lat_h, lat_w = height // 8, width // 8
-        # DDIM timestep subset (trailing spacing, like diffusers)
+        # DDIM timestep subset (leading spacing, like diffusers)
         step_idx = jnp.asarray(
-            np.linspace(0, self.num_train_timesteps - 1, steps)
-            .round().astype(np.int32)[::-1].copy())
+            ddim_timesteps(self.num_train_timesteps, steps,
+                           self.steps_offset))
         runner = self._get_runner(b, lat_h, lat_w, steps)
         return runner(self.params, prompt_ids, uncond_ids, step_idx,
                       jnp.float32(guidance_scale),
@@ -99,6 +116,7 @@ class DiffusionPipeline:
             return self._runners[key_]
         unet, vae, text = self.unet, self.vae, self.text_encoder
         acp = self.alphas_cumprod
+        final_acp = self.final_alpha_cumprod
         lat_c = unet.config.in_channels
 
         def run(params, prompt_ids, uncond_ids, step_idx, g, key):
@@ -112,8 +130,9 @@ class DiffusionPipeline:
                 t_prev_idx = jnp.minimum(i + 1, steps - 1)
                 t_prev = step_idx[t_prev_idx]
                 a_t = acp[t]
-                # last step denoises to alpha=1 (x0)
-                a_prev = jnp.where(i == steps - 1, 1.0, acp[t_prev])
+                # last step denoises toward final_alpha_cumprod
+                # (= alphas_cumprod[0], diffusers set_alpha_to_one=False)
+                a_prev = jnp.where(i == steps - 1, final_acp, acp[t_prev])
                 lat2 = jnp.concatenate([lat, lat])          # CFG batch
                 eps2 = unet.apply(
                     {"params": params["unet"]}, lat2,

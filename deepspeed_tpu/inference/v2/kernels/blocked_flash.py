@@ -218,8 +218,8 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         grid=(s_count,),
         in_specs=[
             pl.BlockSpec((1, h, d), lambda t, slot, pos, tab: (t, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, h, d),
                                lambda t, slot, pos, tab: (t, 0, 0)),

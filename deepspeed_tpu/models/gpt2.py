@@ -18,7 +18,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models.llama import cross_entropy_loss
-from deepspeed_tpu.ops.attention import dot_product_attention
+from deepspeed_tpu.ops.attention import (dot_product_attention,
+                                         folded_attention,
+                                         resolve_attention_layout)
 
 
 @dataclasses.dataclass
@@ -32,12 +34,24 @@ class GPT2Config:
     embd_pdrop: float = 0.0
     attn_pdrop: float = 0.0
     resid_pdrop: float = 0.0
+    # HF `n_inner`: MLP width (None -> the GPT-2 default of 4*n_embd)
+    intermediate_size: Any = None
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    # "folded" | "bshd" | None (None -> the process default set from the
+    # DeepSpeed config's top-level `attention_layout` key). "folded" keeps
+    # attention in the c_attn GEMM's [B,S,H*D] layout — no BSHD<->BHSD
+    # transposes around the flash kernel.
+    attention_layout: Any = None
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return (self.intermediate_size if self.intermediate_size
+                else 4 * self.hidden_size)
 
     @staticmethod
     def gpt2_125m(**kw) -> "GPT2Config":
@@ -77,16 +91,21 @@ class GPT2Block(nn.Module):
         y = ln("ln_1")(x)
         qkv = dense(3 * cfg.hidden_size, "c_attn")(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        reshape = lambda t: t.reshape(*t.shape[:2], h, d)
-        out = dot_product_attention(reshape(q), reshape(k), reshape(v),
-                                    causal=True)
-        out = dense(cfg.hidden_size, "attn_out")(
-            out.reshape(*x.shape[:2], cfg.hidden_size))
+        if resolve_attention_layout(cfg.attention_layout) == "folded":
+            # consume the c_attn GEMM output directly ([B,S,H*D] end to
+            # end); ineligible geometries fall back inside
+            out = folded_attention(q, k, v, num_heads=h, causal=True)
+        else:
+            reshape = lambda t: t.reshape(*t.shape[:2], h, d)
+            out = dot_product_attention(reshape(q), reshape(k), reshape(v),
+                                        causal=True)
+            out = out.reshape(*x.shape[:2], cfg.hidden_size)
+        out = dense(cfg.hidden_size, "attn_out")(out)
         if cfg.resid_pdrop > 0:
             out = nn.Dropout(cfg.resid_pdrop)(out, deterministic=deterministic)
         x = x + out
         y = ln("ln_2")(x)
-        y = dense(4 * cfg.hidden_size, "c_fc")(y)
+        y = dense(cfg.mlp_dim, "c_fc")(y)
         y = nn.gelu(y, approximate=True)
         y = dense(cfg.hidden_size, "c_proj")(y)
         if cfg.resid_pdrop > 0:

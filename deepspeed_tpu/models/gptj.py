@@ -30,12 +30,19 @@ class GPTJConfig:
     rotary_dim: int = 64
     max_position_embeddings: int = 2048
     layer_norm_epsilon: float = 1e-5
+    # HF `n_inner`: MLP width (None -> the GPT-J default of 4*n_embd)
+    intermediate_size: Any = None
     dtype: Any = jnp.bfloat16
     remat: bool = False
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return (self.intermediate_size if self.intermediate_size
+                else 4 * self.hidden_size)
 
     @staticmethod
     def tiny(**kw) -> "GPTJConfig":
@@ -105,7 +112,7 @@ class GPTJBlock(nn.Module):
             feats, use_bias=True, dtype=cfg.dtype,
             param_dtype=jnp.float32, name=name)
         mlp = dense(cfg.hidden_size, "fc_out")(
-            nn.gelu(dense(4 * cfg.hidden_size, "fc_in")(ln),
+            nn.gelu(dense(cfg.mlp_dim, "fc_in")(ln),
                     approximate=True))
         return x + attn + mlp  # parallel residual
 

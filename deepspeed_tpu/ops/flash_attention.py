@@ -19,6 +19,16 @@ Layout: [batch, seq, heads, head_dim] at the boundary (matching
 ``ops.attention``), transposed to [B, H, S, D] around the kernels.
 ``interpret=True`` (automatic off-TPU) runs the same kernels through the
 Pallas interpreter so CPU tests exercise identical code.
+
+``flash_attention_folded`` is the layout-native variant: q/k/v stay in the
+head-folded [B, S, H*D] lane layout the QKV projection GEMM emits, so the
+BSHD<->BHSD transposes (13.8 ms of the 86 ms honest-geometry step,
+PERFLOG round 5) disappear. Per-head access is expressed as static lane
+-block slices in the BlockSpec index maps — the grid stays per-(head
+group), preserving Mosaic's cross-grid-step pipelining (NOT the rejected
+in-kernel ``fori`` designs, PERFLOG items 1-4). For head dims below the
+128-lane tile (d=64) one grid step covers a lane-aligned *group* of
+heads (a pair for MHA d=64) and a short static unroll walks the group.
 """
 
 from __future__ import annotations
@@ -77,6 +87,32 @@ def flash_attention_usable(q, k, v, causal, mask) -> bool:
     return _on_tpu()
 
 
+def _causal_keep(iq, ik, block_q, block_k, causal_offset, window):
+    """[bq, bk] bool tile of visible (row, col) pairs for q-block iq x
+    k-block ik under end-aligned causal masking (+ optional sliding
+    window) — shared by every kernel variant in this file."""
+    rows = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    keep = rows + causal_offset >= cols
+    if window is not None:
+        keep = jnp.logical_and(keep, cols > rows + causal_offset - window)
+    return keep
+
+
+def _run_predicate(iq, ik, block_q, block_k, causal, causal_offset, window):
+    """Whether q-block iq x k-block ik intersects the visible band at all
+    (skip blocks fully above the causal diagonal / below the window)."""
+    run = jnp.logical_or(not causal,
+                         (iq + 1) * block_q - 1 + causal_offset >= ik * block_k)
+    if window is not None:
+        run = jnp.logical_and(
+            run,
+            (ik + 1) * block_k - 1 > iq * block_q + causal_offset - window)
+    return run
+
+
 # ===================================================================== #
 # Forward
 # ===================================================================== #
@@ -94,14 +130,8 @@ def _fwd_kernel_onepass(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal,
         q, kb, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)           # [bq, bk] f32
     if causal:
-        rows = iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        cols = jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        keep = rows + causal_offset >= cols
-        if window is not None:
-            keep = jnp.logical_and(keep, cols > rows + causal_offset - window)
-        s = jnp.where(keep, s, NEG_INF)
+        s = jnp.where(_causal_keep(iq, 0, block_q, block_k, causal_offset,
+                                   window), s, NEG_INF)
     m = jnp.max(s, axis=1, keepdims=True)             # [bq, 1]
     p = jnp.exp(s - m)                                # [bq, bk] f32
     l = jnp.sum(p, axis=1, keepdims=True)             # [bq, 1]
@@ -127,14 +157,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # skip blocks entirely above the causal diagonal, and (sliding
-    # window) blocks entirely below the band col > row - window
-    run = jnp.logical_or(not causal,
-                         (iq + 1) * block_q - 1 + causal_offset >= ik * block_k)
-    if window is not None:
-        run = jnp.logical_and(
-            run,
-            (ik + 1) * block_k - 1 > iq * block_q + causal_offset - window)
+    run = _run_predicate(iq, ik, block_q, block_k, causal, causal_offset,
+                         window)
 
     @pl.when(run)
     def _():
@@ -149,15 +173,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, bk] f32
         if causal:
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            keep = rows + causal_offset >= cols
-            if window is not None:
-                keep = jnp.logical_and(
-                    keep, cols > rows + causal_offset - window)
-            s = jnp.where(keep, s, NEG_INF)
+            s = jnp.where(_causal_keep(iq, ik, block_q, block_k,
+                                       causal_offset, window), s, NEG_INF)
 
         m_prev = m_ref[:, :1]                          # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -252,12 +269,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = jnp.logical_or(not causal,
-                         (iq + 1) * block_q - 1 + causal_offset >= ik * block_k)
-    if window is not None:
-        run = jnp.logical_and(
-            run,
-            (ik + 1) * block_k - 1 > iq * block_q + causal_offset - window)
+    run = _run_predicate(iq, ik, block_q, block_k, causal, causal_offset,
+                         window)
 
     @pl.when(run)
     def _():
@@ -271,15 +284,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            keep = rows + causal_offset >= cols
-            if window is not None:
-                keep = jnp.logical_and(
-                    keep, cols > rows + causal_offset - window)
-            s = jnp.where(keep, s, NEG_INF)
+            s = jnp.where(_causal_keep(iq, ik, block_q, block_k,
+                                       causal_offset, window), s, NEG_INF)
         p = jnp.exp(s - lse)                          # [bq, bk] f32
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -305,12 +311,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = jnp.logical_or(not causal,
-                         (iq + 1) * block_q - 1 + causal_offset >= ik * block_k)
-    if window is not None:
-        run = jnp.logical_and(
-            run,
-            (ik + 1) * block_k - 1 > iq * block_q + causal_offset - window)
+    run = _run_predicate(iq, ik, block_q, block_k, causal, causal_offset,
+                         window)
 
     @pl.when(run)
     def _():
@@ -324,15 +326,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            keep = rows + causal_offset >= cols
-            if window is not None:
-                keep = jnp.logical_and(
-                    keep, cols > rows + causal_offset - window)
-            s = jnp.where(keep, s, NEG_INF)
+            s = jnp.where(_causal_keep(iq, ik, block_q, block_k,
+                                       causal_offset, window), s, NEG_INF)
         p = jnp.exp(s - lse)                           # [bq, bk] f32
         pb = p.astype(do.dtype)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -507,3 +502,496 @@ def flash_attention(q, k, v, *, causal: bool = True,
                int(block_k), bool(interpret),
                int(window) if window is not None else None)
     return o.transpose(0, 2, 1, 3)
+
+
+# ===================================================================== #
+# Folded-layout ("layout-native") variant: q/k/v in [B, S, H*D]
+# ===================================================================== #
+# The projection GEMM emits [B, S, H*D]; the kernels below consume it
+# directly. Head h lives in lanes [h*d, (h+1)*d) — a BlockSpec block of
+# ``hb`` heads (hb*d lanes) per grid step keeps every DMA window 128-lane
+# aligned. The grid is per head-GROUP (hb heads), so Mosaic still
+# software-pipelines DMA/MXU/VPU across grid steps; inside a step a short
+# STATIC python unroll (hb <= 8, typically 1-2) walks the group with
+# static lane slices. lse/delta stay head-major [B, H, S, 8] (tiny).
+
+_FOLDED_MAX_HEADS_PER_BLOCK = 8  # VMEM guard: hb fp32 [bq, bk] score tiles
+
+
+def folded_heads_per_block(num_heads: int, num_kv_heads: int,
+                           head_dim: int) -> Optional[int]:
+    """Query heads per grid step for the folded layout, or None when the
+    geometry has no lane-aligned grouping.
+
+    d % 128 == 0: singleton blocks — every per-head lane window is
+    already 128-aligned. Otherwise a group of ``m = 128/gcd(d,128)``
+    heads spans whole lane tiles; the group is widened to ``m * g`` so
+    the KV heads it touches also form whole tiles (g = GQA group size).
+    """
+    d, h, hkv = head_dim, num_heads, num_kv_heads
+    if d % 8 != 0 or h % hkv != 0:
+        return None
+    if d % 128 == 0:
+        return 1
+    import math
+
+    m = 128 // math.gcd(d, 128)
+    hb = m * (h // hkv)
+    if hb > _FOLDED_MAX_HEADS_PER_BLOCK or h % hb != 0:
+        return None
+    return hb
+
+
+def flash_attention_folded_usable(q, k, v, num_heads, num_kv_heads,
+                                  causal, mask) -> bool:
+    """Folded-kernel eligibility for the auto path (mirrors
+    :func:`flash_attention_usable`)."""
+    if mask is not None:
+        return False
+    if q.ndim != 3 or q.shape[-1] % num_heads or \
+            k.shape[-1] % num_kv_heads:
+        return False
+    d = q.shape[-1] // num_heads
+    if k.shape[-1] // num_kv_heads != d:
+        return False
+    if folded_heads_per_block(num_heads, num_kv_heads, d) is None:
+        return False
+    sq, sk = q.shape[1], k.shape[1]
+    if sq % _pick_block(sq, DEFAULT_BLOCK_Q) or \
+            sk % _pick_block(sk, DEFAULT_BLOCK_K):
+        return False
+    if sq * sk < 128 * 128:
+        return False
+    return _on_tpu()
+
+
+def _fwd_kernel_folded_onepass(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                               causal, block_q, block_k, causal_offset,
+                               window, hb, g, d):
+    """Single-k-block folded forward: whole key range visible, plain
+    softmax per head of the group (see _fwd_kernel_onepass)."""
+    iq = pl.program_id(2)
+    if causal:
+        keep = _causal_keep(iq, 0, block_q, block_k, causal_offset, window)
+    outs, lses = [], []
+    for j in range(hb):                       # static unroll over the group
+        jk = j // g                           # local KV head in this block
+        q = q_ref[0, :, j * d:(j + 1) * d]            # [bq, d] bf16
+        kb = k_ref[0, :, jk * d:(jk + 1) * d]         # [bk, d] bf16
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk] f32
+        if causal:
+            s = jnp.where(keep, s, NEG_INF)
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        vb = v_ref[0, :, jk * d:(jk + 1) * d]
+        acc = jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        outs.append((acc / safe_l).astype(o_ref.dtype))
+        lses.append(jnp.broadcast_to(m + jnp.log(safe_l), (block_q, 8)))
+    o_ref[0] = jnp.concatenate(outs, axis=-1)
+    lse_ref[0] = jnp.stack(lses)
+
+
+def _fwd_kernel_folded(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       acc_ref, m_ref, l_ref, *, causal, block_q, block_k,
+                       num_k_blocks, causal_offset, window, hb, g, d):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    run = _run_predicate(iq, ik, block_q, block_k, causal, causal_offset,
+                         window)
+
+    @pl.when(run)
+    def _():
+        if causal:
+            keep = _causal_keep(iq, ik, block_q, block_k, causal_offset,
+                                window)
+        for j in range(hb):
+            jk = j // g
+            q = q_ref[0, :, j * d:(j + 1) * d]
+            kb = k_ref[0, :, jk * d:(jk + 1) * d]
+            s = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if causal:
+                s = jnp.where(keep, s, NEG_INF)
+            m_prev = m_ref[j, :, :1]                   # [bq, 1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_new = l_ref[j, :, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+            vb = v_ref[0, :, jk * d:(jk + 1) * d]
+            acc_ref[j] = acc_ref[j] * corr + jax.lax.dot_general(
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[j] = jnp.broadcast_to(m_new, m_ref[j].shape)
+            l_ref[j] = jnp.broadcast_to(l_new, l_ref[j].shape)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _():
+        outs, lses = [], []
+        for j in range(hb):
+            l = l_ref[j, :, :1]
+            safe_l = jnp.where(l == 0.0, 1.0, l)
+            outs.append((acc_ref[j] / safe_l).astype(o_ref.dtype))
+            lses.append(jnp.broadcast_to(m_ref[j, :, :1] + jnp.log(safe_l),
+                                         (block_q, 8)))
+        o_ref[0] = jnp.concatenate(outs, axis=-1)
+        lse_ref[0] = jnp.stack(lses)
+
+
+def _fwd_folded(q, k, v, *, h, hkv, causal, block_q, block_k, interpret,
+                window=None):
+    """q (PRE-SCALED): [B, Sq, H*D]; k/v: [B, Sk, Hkv*D]
+    -> (o: [B, Sq, H*D], lse: [B, H, Sq, 8])."""
+    b, sq, _ = q.shape
+    sk = k.shape[1]
+    d = q.shape[-1] // h
+    g = h // hkv
+    hb = folded_heads_per_block(h, hkv, d)
+    kvb = max(1, hb // g)                 # KV heads per grid step
+    nq = sq // block_q
+    nk = sk // block_k
+
+    # hb == 1 (d % 128 == 0): the KV block is one head, indexed hp // g;
+    # hb == m*g: the group's KV heads are exactly block hp of kvb heads.
+    if hb == 1:
+        idx_k = lambda b_, hp, iq, *r: (b_, (iq, *r)[-1], hp // g)
+    else:
+        idx_k = lambda b_, hp, iq, *r: (b_, (iq, *r)[-1], hp)
+
+    if nk == 1:
+        kernel = functools.partial(
+            _fwd_kernel_folded_onepass, causal=causal, block_q=block_q,
+            block_k=block_k, causal_offset=sk - sq, window=window,
+            hb=hb, g=g, d=d)
+        grid = (b, h // hb, nq)
+        idx_q = lambda b_, hp, iq: (b_, iq, hp)
+        idx_kv = lambda b_, hp, iq: idx_k(b_, hp, iq, 0)
+        idx_l = lambda b_, hp, iq: (b_, hp, iq, 0)
+        scratch = []
+    else:
+        kernel = functools.partial(
+            _fwd_kernel_folded, causal=causal, block_q=block_q,
+            block_k=block_k, num_k_blocks=nk, causal_offset=sk - sq,
+            window=window, hb=hb, g=g, d=d)
+        grid = (b, h // hb, nq, nk)
+        idx_q = lambda b_, hp, iq, ik: (b_, iq, hp)
+        idx_kv = lambda b_, hp, iq, ik: idx_k(b_, hp, iq, ik)
+        idx_l = lambda b_, hp, iq, ik: (b_, hp, iq, 0)
+        scratch = [
+            pltpu.VMEM((hb, block_q, d), jnp.float32),
+            pltpu.VMEM((hb, block_q, 128), jnp.float32),
+            pltpu.VMEM((hb, block_q, 128), jnp.float32),
+        ]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, hb * d), idx_q),
+            pl.BlockSpec((1, block_k, kvb * d), idx_kv),
+            pl.BlockSpec((1, block_k, kvb * d), idx_kv),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, hb * d), idx_q),
+            pl.BlockSpec((1, hb, block_q, 8), idx_l),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, h * d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 8), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _bwd_dq_kernel_folded(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, dq_acc, *, scale, causal, block_q,
+                          block_k, num_k_blocks, causal_offset, window,
+                          hb, g, d):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = _run_predicate(iq, ik, block_q, block_k, causal, causal_offset,
+                         window)
+
+    @pl.when(run)
+    def _():
+        if causal:
+            keep = _causal_keep(iq, ik, block_q, block_k, causal_offset,
+                                window)
+        for j in range(hb):
+            jk = j // g
+            q = q_ref[0, :, j * d:(j + 1) * d]
+            kb = k_ref[0, :, jk * d:(jk + 1) * d]
+            vb = v_ref[0, :, jk * d:(jk + 1) * d]
+            do = do_ref[0, :, j * d:(j + 1) * d]
+            lse = lse_ref[0, j][:, :1]
+            delta = delta_ref[0, j][:, :1]
+            s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if causal:
+                s = jnp.where(keep, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta)).astype(kb.dtype)
+            dq_acc[j] = dq_acc[j] + jax.lax.dot_general(
+                ds, kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _():
+        dq_ref[0] = jnp.concatenate(
+            [(dq_acc[j] * scale).astype(dq_ref.dtype) for j in range(hb)],
+            axis=-1)
+
+
+def _bwd_dkv_kernel_folded(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, *, causal,
+                           block_q, block_k, num_q_blocks, causal_offset,
+                           window, hb, g, d):
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = _run_predicate(iq, ik, block_q, block_k, causal, causal_offset,
+                         window)
+
+    @pl.when(run)
+    def _():
+        if causal:
+            keep = _causal_keep(iq, ik, block_q, block_k, causal_offset,
+                                window)
+        for j in range(hb):
+            jk = j // g
+            q = q_ref[0, :, j * d:(j + 1) * d]
+            kb = k_ref[0, :, jk * d:(jk + 1) * d]
+            vb = v_ref[0, :, jk * d:(jk + 1) * d]
+            do = do_ref[0, :, j * d:(j + 1) * d]
+            lse = lse_ref[0, j][:, :1]
+            delta = delta_ref[0, j][:, :1]
+            s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if causal:
+                s = jnp.where(keep, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            pb = p.astype(do.dtype)
+            dv_acc[j] = dv_acc[j] + jax.lax.dot_general(
+                pb, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta)).astype(q.dtype)
+            dk_acc[j] = dk_acc[j] + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _():
+        dk_ref[0] = jnp.concatenate(
+            [dk_acc[j].astype(dk_ref.dtype) for j in range(hb)], axis=-1)
+        dv_ref[0] = jnp.concatenate(
+            [dv_acc[j].astype(dv_ref.dtype) for j in range(hb)], axis=-1)
+
+
+def _bwd_folded(res, grads, *, h, hkv, scale, causal, block_q, block_k,
+                interpret, window=None):
+    q, k, v, o, lse = res  # q is the PRE-SCALED folded query
+    do = grads[0]
+    b, sq, _ = q.shape
+    sk = k.shape[1]
+    d = q.shape[-1] // h
+    g = h // hkv
+    hb = folded_heads_per_block(h, hkv, d)
+    kvb = max(1, hb // g)
+    nq = sq // block_q
+    nk = sk // block_k
+
+    # delta_i = rowsum(dO_i * O_i), head-major like lse. The [B,Sq,H]
+    # transpose is fp32 and tiny (b*s*h words — ~0.4 MB on the honest
+    # geometry), nothing like the [B,S,H,D] transposes this path removes.
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)) \
+        .reshape(b, sq, h, d).sum(axis=-1).transpose(0, 2, 1)
+    delta = jnp.broadcast_to(delta[..., None], (b, h, sq, 8))
+
+    if hb == 1:
+        idx_k = lambda b_, hp, _i, last: (b_, last, hp // g)
+    else:
+        idx_k = lambda b_, hp, _i, last: (b_, last, hp)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_folded, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                          causal_offset=sk - sq, window=window,
+                          hb=hb, g=g, d=d),
+        grid=(b, h // hb, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hb * d),
+                         lambda b_, hp, iq, ik: (b_, iq, hp)),
+            pl.BlockSpec((1, block_k, kvb * d),
+                         lambda b_, hp, iq, ik: idx_k(b_, hp, iq, ik)),
+            pl.BlockSpec((1, block_k, kvb * d),
+                         lambda b_, hp, iq, ik: idx_k(b_, hp, iq, ik)),
+            pl.BlockSpec((1, block_q, hb * d),
+                         lambda b_, hp, iq, ik: (b_, iq, hp)),
+            pl.BlockSpec((1, hb, block_q, 8),
+                         lambda b_, hp, iq, ik: (b_, hp, iq, 0)),
+            pl.BlockSpec((1, hb, block_q, 8),
+                         lambda b_, hp, iq, ik: (b_, hp, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, hb * d),
+                               lambda b_, hp, iq, ik: (b_, iq, hp)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((hb, block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dK/dV per q-head (folded [B, Sk, H*D]), then sum each GQA group
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_folded, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq,
+                          causal_offset=sk - sq, window=window,
+                          hb=hb, g=g, d=d),
+        grid=(b, h // hb, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hb * d),
+                         lambda b_, hp, ik, iq: (b_, iq, hp)),
+            pl.BlockSpec((1, block_k, kvb * d),
+                         lambda b_, hp, ik, iq: idx_k(b_, hp, iq, ik)),
+            pl.BlockSpec((1, block_k, kvb * d),
+                         lambda b_, hp, ik, iq: idx_k(b_, hp, iq, ik)),
+            pl.BlockSpec((1, block_q, hb * d),
+                         lambda b_, hp, ik, iq: (b_, iq, hp)),
+            pl.BlockSpec((1, hb, block_q, 8),
+                         lambda b_, hp, ik, iq: (b_, hp, iq, 0)),
+            pl.BlockSpec((1, hb, block_q, 8),
+                         lambda b_, hp, ik, iq: (b_, hp, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, hb * d),
+                         lambda b_, hp, ik, iq: (b_, ik, hp)),
+            pl.BlockSpec((1, block_k, hb * d),
+                         lambda b_, hp, ik, iq: (b_, ik, hp)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sk, h * d), k.dtype),
+            jax.ShapeDtypeStruct((b, sk, h * d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((hb, block_k, d), jnp.float32),
+                        pltpu.VMEM((hb, block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    if g > 1:
+        dk = dk_h.reshape(b, sk, hkv, g, d).sum(axis=3).reshape(b, sk, -1)
+        dv = dv_h.reshape(b, sk, hkv, g, d).sum(axis=3).reshape(b, sk, -1)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=tuple(range(3, 11)))
+def _flash_folded(q, k, v, h, hkv, scale, causal, block_q, block_k,
+                  interpret, window):
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    o, _ = _fwd_folded(qs, k, v, h=h, hkv=hkv, causal=causal,
+                       block_q=block_q, block_k=block_k,
+                       interpret=interpret, window=window)
+    return o
+
+
+def _flash_folded_fwd(q, k, v, h, hkv, scale, causal, block_q, block_k,
+                      interpret, window):
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    o, lse = _fwd_folded(qs, k, v, h=h, hkv=hkv, causal=causal,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret, window=window)
+    return o, (qs, k, v, o, lse)
+
+
+def _flash_folded_bwd(h, hkv, scale, causal, block_q, block_k, interpret,
+                      window, res, g):
+    return _bwd_folded(res, (g,), h=h, hkv=hkv, scale=scale, causal=causal,
+                       block_q=block_q, block_k=block_k,
+                       interpret=interpret, window=window)
+
+
+_flash_folded.defvjp(_flash_folded_fwd, _flash_folded_bwd)
+
+
+def flash_attention_folded(q, k, v, *, num_heads: int,
+                           num_kv_heads: Optional[int] = None,
+                           causal: bool = True,
+                           mask: Optional[jax.Array] = None,
+                           scale: Optional[float] = None,
+                           window: Optional[int] = None,
+                           block_q: Optional[int] = None,
+                           block_k: Optional[int] = None,
+                           interpret: Optional[bool] = None):
+    """Layout-native flash attention. q: [B,Sq,H*D]; k/v: [B,Sk,Hkv*D];
+    returns [B,Sq,H*D] — no [B,S,H,D] round-trip on either the forward
+    or the ``custom_vjp`` backward.
+
+    Semantics (causal / sliding ``window`` / GQA / ``scale``) match
+    :func:`flash_attention` exactly; only the array layout differs.
+    """
+    if mask is not None:
+        raise NotImplementedError(
+            "flash_attention_folded supports causal/full (+sliding window) "
+            "only; use ops.attention.dot_product_attention for custom masks")
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
+    if window is not None and window <= 0:
+        raise ValueError(f"window must be positive, got {window}")
+    hkv = num_kv_heads if num_kv_heads is not None else num_heads
+    if q.ndim != 3 or k.ndim != 3 or v.ndim != 3:
+        raise ValueError("folded layout expects rank-3 [B, S, H*D] inputs")
+    b, sq, hd = q.shape
+    _, sk, kvd = k.shape
+    if num_heads % hkv:
+        raise ValueError(f"GQA needs H % Hkv == 0, got {num_heads} % {hkv}")
+    if hd % num_heads or kvd % hkv:
+        raise ValueError(
+            f"folded widths ({hd}, {kvd}) must be divisible by their head "
+            f"counts ({num_heads}, {hkv})")
+    d = hd // num_heads
+    if kvd // hkv != d:
+        raise ValueError(
+            f"q head_dim {d} != kv head_dim {kvd // hkv}")
+    if folded_heads_per_block(num_heads, hkv, d) is None:
+        raise ValueError(
+            f"no lane-aligned head grouping for H={num_heads} Hkv={hkv} "
+            f"d={d}; use the [B,S,H,D] flash_attention path")
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    block_q = block_q or _pick_block(sq, DEFAULT_BLOCK_Q)
+    block_k = block_k or _pick_block(sk, DEFAULT_BLOCK_K)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _flash_folded(q, k, v, int(num_heads), int(hkv), float(scale),
+                         bool(causal), int(block_q), int(block_k),
+                         bool(interpret),
+                         int(window) if window is not None else None)

@@ -73,6 +73,8 @@ _OP_REGISTRY: Dict[str, tuple] = {
     "cpu_adagrad": ("deepspeed_tpu.ops.optimizers", "adagrad"),
     "cpu_lion": ("deepspeed_tpu.ops.optimizers", "fused_lion"),
     "flash_attn": ("deepspeed_tpu.ops.flash_attention", "flash_attention"),
+    "flash_attn_folded": ("deepspeed_tpu.ops.flash_attention",
+                          "flash_attention_folded"),
     "quantizer": ("deepspeed_tpu.ops.quantizer", None),
     "transformer": ("deepspeed_tpu.ops.transformer", None),
     "transformer_inference": ("deepspeed_tpu.ops.transformer", None),

@@ -67,6 +67,39 @@ def test_ddim_schedule_matches_diffusers_formula():
     np.testing.assert_allclose(acp, np.cumprod(1 - betas), rtol=1e-5)
 
 
+def test_ddim_timesteps_leading_spacing_and_final_alpha():
+    """diffusers DDIMScheduler "leading" spacing
+    (arange(steps) * (T//steps) + steps_offset, descending) and SD's
+    scheduler config: steps_offset=1, set_alpha_to_one=False final alpha
+    (= alphas_cumprod[0])."""
+    from deepspeed_tpu.inference.diffusion import ddim_timesteps
+
+    got = ddim_timesteps(1000, 50)
+    want = (np.arange(50) * (1000 // 50))[::-1].astype(np.int32)
+    np.testing.assert_array_equal(got, want)
+    assert got[0] == 980 and got[-1] == 0  # leading, not trailing/linspace
+    # SD's steps_offset=1 (what DiffusionPipeline defaults to): diffusers
+    # produces [981, 961, ..., 1] for 50 steps
+    got_sd = ddim_timesteps(1000, 50, steps_offset=1)
+    np.testing.assert_array_equal(got_sd, want + 1)
+    assert got_sd[0] == 981 and got_sd[-1] == 1
+
+    ucfg = UNetConfig.tiny(dtype=jnp.float32)
+    vcfg = VAEConfig.tiny(dtype=jnp.float32)
+    tcfg = CLIPTextConfig.tiny(dtype=jnp.float32)
+    unet, vae, text = (UNet2DCondition(ucfg), VAEDecoder(vcfg),
+                       CLIPTextEncoder(tcfg))
+    rng = jax.random.key(0)
+    lat = jnp.zeros((1, 8, 8, 4), jnp.float32)
+    pipe = DiffusionPipeline(
+        unet, unet.init(rng, lat, jnp.zeros((1,), jnp.int32),
+                        jnp.zeros((1, 4, tcfg.hidden_size)))["params"],
+        vae, vae.init(rng, lat)["params"],
+        text, text.init(rng, jnp.zeros((1, 4), jnp.int32))["params"])
+    np.testing.assert_allclose(float(pipe.final_alpha_cumprod),
+                               float(pipe.alphas_cumprod[0]), rtol=1e-6)
+
+
 def test_pipeline_end_to_end_and_deterministic(tiny_stack):
     (unet, up), (vae, vp), (text, tp), _ = tiny_stack
     pipe = DiffusionPipeline(unet, up, vae, vp, text, tp)

@@ -180,3 +180,238 @@ def test_flash_window_requires_causal():
     q = jnp.zeros((1, 128, 2, 32))
     with pytest.raises(ValueError, match="causal"):
         flash_attention(q, q, q, causal=False, window=16)
+
+
+# ===================================================================== #
+# Folded ([B, S, H*D]) layout-native kernels
+# ===================================================================== #
+from deepspeed_tpu.ops.attention import (folded_attention,  # noqa: E402
+                                         get_default_attention_layout,
+                                         set_default_attention_layout)
+from deepspeed_tpu.ops.flash_attention import (  # noqa: E402
+    flash_attention_folded, flash_attention_folded_usable,
+    folded_heads_per_block)
+
+
+def _make_folded(b=2, sq=256, sk=256, h=4, hkv=4, d=64, dtype=jnp.float32,
+                 seed=0):
+    """Returns folded (q, k, v) plus their [B,S,H,D] views for the ref."""
+    q, k, v = _make(b=b, sq=sq, sk=sk, h=h, hkv=hkv, d=d, dtype=dtype,
+                    seed=seed)
+    fold = lambda t: t.reshape(t.shape[0], t.shape[1], -1)
+    return (fold(q), fold(k), fold(v)), (q, k, v)
+
+
+# d=64 exercises the head-group (hb>1) kernels, d=128 the singleton-head
+# blocks; the explicit small blocks force the multi-k-block online-softmax
+# kernel where the defaults would select the one-pass variant.
+FOLDED_GEOMS = [(4, 4, 64), (4, 2, 64), (4, 4, 128), (4, 2, 128)]
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("h,hkv,d", FOLDED_GEOMS)
+def test_folded_forward_matches_xla(h, hkv, d, causal):
+    (qf, kf, vf), (q, k, v) = _make_folded(h=h, hkv=hkv, d=d)
+    ref = _xla_attention(q, k, v, causal=causal, mask=None, scale=None)
+    for blocks in ({}, {"block_q": 64, "block_k": 128}):
+        out = flash_attention_folded(qf, kf, vf, num_heads=h,
+                                     num_kv_heads=hkv, causal=causal,
+                                     interpret=True, **blocks)
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(ref.shape), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("h,hkv,d", FOLDED_GEOMS)
+def test_folded_grads_match_xla(h, hkv, d):
+    """jax.grad through flash_attention_folded exercises the custom_vjp
+    backward (folded dq + folded group-summed dk/dv)."""
+    (qf, kf, vf), (q, k, v) = _make_folded(h=h, hkv=hkv, d=d)
+
+    def loss_f(q_, k_, v_):
+        return jnp.sum(flash_attention_folded(
+            q_, k_, v_, num_heads=h, num_kv_heads=hkv, causal=True,
+            block_q=64, block_k=128, interpret=True) ** 2)
+
+    def loss_r(q_, k_, v_):
+        return jnp.sum(_xla_attention(q_, k_, v_, causal=True, mask=None,
+                                      scale=None) ** 2)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(qf, kf, vf)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        scale = float(jnp.abs(b).max()) + 1e-9
+        np.testing.assert_allclose(np.asarray(a).reshape(b.shape) / scale,
+                                   np.asarray(b) / scale,
+                                   atol=1e-4, err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("h,hkv,d", [(4, 4, 64), (4, 2, 128)])
+def test_folded_bf16_within_selftest_tolerances(h, hkv, d):
+    """The acceptance tolerances of the on-chip selftest (fwd 2e-2, grad
+    2.5e-1 at bf16) hold through the interpreter too."""
+    (qf, kf, vf), (q, k, v) = _make_folded(h=h, hkv=hkv, d=d,
+                                           dtype=jnp.bfloat16)
+    ref = _xla_attention(q, k, v, causal=True, mask=None, scale=None)
+    out = flash_attention_folded(qf, kf, vf, num_heads=h, num_kv_heads=hkv,
+                                 causal=True, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    assert float(jnp.max(jnp.abs(
+        out.astype(jnp.float32).reshape(ref.shape)
+        - ref.astype(jnp.float32)))) < 2e-2
+
+    gf = jax.grad(lambda a, b, c: jnp.sum(flash_attention_folded(
+        a, b, c, num_heads=h, num_kv_heads=hkv, causal=True,
+        interpret=True).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(qf, kf, vf)
+    gr = jax.grad(lambda a, b, c: jnp.sum(_xla_attention(
+        a, b, c, causal=True, mask=None,
+        scale=None).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    err = max(float(jnp.max(jnp.abs(
+        a.astype(jnp.float32).reshape(b.shape) - b.astype(jnp.float32))))
+        for a, b in zip(gf, gr))
+    assert err < 2.5e-1
+
+
+def test_folded_sliding_window_matches_banded_xla():
+    """Window fwd AND bwd (the window term of the run predicate / keep
+    mask must hold through the custom_vjp, not just the forward)."""
+    (qf, kf, vf), (q, k, v) = _make_folded(h=4, hkv=4, d=64)
+    ref = _xla_attention(q, k, v, causal=True, mask=None, scale=None,
+                         window=64)
+    out = flash_attention_folded(qf, kf, vf, num_heads=4, causal=True,
+                                 window=64, block_q=64, block_k=64,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(out).reshape(ref.shape),
+                               np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    gf = jax.grad(lambda a, b, c: jnp.sum(flash_attention_folded(
+        a, b, c, num_heads=4, causal=True, window=64, block_q=64,
+        block_k=64, interpret=True) ** 2), argnums=(0, 1, 2))(qf, kf, vf)
+    gr = jax.grad(lambda a, b, c: jnp.sum(_xla_attention(
+        a, b, c, causal=True, mask=None, scale=None,
+        window=64) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        np.testing.assert_allclose(np.asarray(a).reshape(b.shape),
+                                   np.asarray(b), rtol=2e-4, atol=2e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_folded_rectangular_causal_end_aligned():
+    (qf, kf, vf), (q, k, v) = _make_folded(sq=128, sk=512)
+    ref = _xla_attention(q, k, v, causal=True, mask=None, scale=None)
+    out = flash_attention_folded(qf, kf, vf, num_heads=4, causal=True,
+                                 block_q=64, block_k=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(out).reshape(ref.shape),
+                               np.asarray(ref), atol=2e-5)
+
+
+def test_folded_heads_per_block_grouping():
+    assert folded_heads_per_block(12, 12, 64) == 2   # MHA d64: lane pair
+    assert folded_heads_per_block(4, 2, 64) == 4     # GQA g=2 d64
+    assert folded_heads_per_block(8, 2, 128) == 1    # d128: singleton
+    assert folded_heads_per_block(3, 3, 64) is None  # 3 heads: no pair
+    assert folded_heads_per_block(4, 4, 48) is None  # 48 lanes: no tile
+
+
+def test_folded_validation_errors():
+    q = jnp.zeros((1, 128, 256))
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention_folded(q, q, q, num_heads=3, interpret=True)
+    with pytest.raises(ValueError, match="lane-aligned"):
+        flash_attention_folded(jnp.zeros((1, 128, 192)),
+                               jnp.zeros((1, 128, 192)),
+                               jnp.zeros((1, 128, 192)),
+                               num_heads=3, interpret=True)
+    with pytest.raises(NotImplementedError):
+        flash_attention_folded(q, q, q, num_heads=4,
+                               mask=jnp.ones((1,), bool), interpret=True)
+    with pytest.raises(ValueError, match="rank-3"):
+        flash_attention_folded(jnp.zeros((1, 128, 4, 64)),
+                               jnp.zeros((1, 128, 4, 64)),
+                               jnp.zeros((1, 128, 4, 64)),
+                               num_heads=4, interpret=True)
+
+
+def test_folded_usable_gate():
+    (qf, kf, vf), _ = _make_folded()
+    # CPU platform: not usable (auto path keeps the fallback)
+    assert not flash_attention_folded_usable(qf, kf, vf, 4, 4, True, None)
+    # mask always falls back
+    assert not flash_attention_folded_usable(qf, kf, vf, 4, 4, True,
+                                             jnp.ones((1,), bool))
+    # no lane-aligned grouping falls back
+    (q3, k3, v3), _ = _make_folded(h=3, hkv=3, d=64)
+    assert not flash_attention_folded_usable(q3, k3, v3, 3, 3, True, None)
+
+
+def test_folded_attention_pallas_switch_and_fallback():
+    """implementation='pallas' runs the folded kernel (interpret off-TPU);
+    the auto path off-TPU falls back through the free reshape and still
+    matches — both against the XLA reference."""
+    (qf, kf, vf), (q, k, v) = _make_folded(h=4, hkv=2, d=64)
+    ref = _xla_attention(q, k, v, causal=True, mask=None, scale=None)
+    out_kernel = folded_attention(qf, kf, vf, num_heads=4, num_kv_heads=2,
+                                  causal=True, implementation="pallas")
+    np.testing.assert_allclose(np.asarray(out_kernel).reshape(ref.shape),
+                               np.asarray(ref), atol=2e-5)
+    out_auto = folded_attention(qf, kf, vf, num_heads=4, num_kv_heads=2,
+                                causal=True)
+    np.testing.assert_allclose(np.asarray(out_auto).reshape(ref.shape),
+                               np.asarray(ref), atol=2e-5)
+
+
+# ===================================================================== #
+# attention_layout config plumbing
+# ===================================================================== #
+@pytest.fixture
+def _restore_layout():
+    prev = get_default_attention_layout()
+    yield
+    set_default_attention_layout(prev)
+
+
+def test_attention_layout_config_parse(_restore_layout):
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    base = {"train_micro_batch_size_per_gpu": 1}
+    assert DeepSpeedConfig(base).attention_layout == "bshd"
+    assert DeepSpeedConfig({**base, "attention_layout": "folded"}) \
+        .attention_layout == "folded"
+    with pytest.raises(ValueError, match="attention_layout"):
+        DeepSpeedConfig({**base, "attention_layout": "bhsd"})
+    with pytest.raises(ValueError, match="attention_layout"):
+        set_default_attention_layout("nope")
+    set_default_attention_layout("folded")
+    assert get_default_attention_layout() == "folded"
+
+
+@pytest.mark.parametrize("model_name", ["gpt2", "llama"])
+def test_attention_layout_selects_and_falls_back(model_name, _restore_layout):
+    """A model with attention_layout='folded' routes through
+    folded_attention (off-TPU: the reshape fallback) and must match the
+    bshd path exactly; None defers to the process default."""
+    import flax.linen as nn  # noqa: F401 — model import sanity
+
+    if model_name == "gpt2":
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        make = lambda layout: GPT2LMHeadModel(
+            GPT2Config.tiny(dtype=jnp.float32, attention_layout=layout))
+    else:
+        from deepspeed_tpu.models.llama import (LlamaConfig,
+                                                LlamaForCausalLM)
+        make = lambda layout: LlamaForCausalLM(
+            LlamaConfig.tiny(dtype=jnp.float32, attention_layout=layout))
+
+    ids = np.arange(32, dtype=np.int32).reshape(1, 32) % 250
+    params = make("bshd").init(jax.random.key(0), ids)
+    ref = make("bshd").apply(params, ids)
+    out_folded = make("folded").apply(params, ids)
+    np.testing.assert_allclose(np.asarray(out_folded), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    # None defers to the process-wide default (what the engine sets from
+    # the DeepSpeed config's attention_layout key)
+    set_default_attention_layout("folded")
+    out_default = make(None).apply(params, ids)
+    np.testing.assert_allclose(np.asarray(out_default), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)

@@ -102,6 +102,26 @@ def test_gpt2_logits_match_hf(tmp_path):
     np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=1e-3)
 
 
+def test_gpt2_nondefault_n_inner_loads_and_matches(tmp_path):
+    """Non-default HF ``n_inner`` must reach GPT2Config.intermediate_size
+    (same hardcoded-4x shape-error fix as GPT-J)."""
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_inner=96,
+        n_positions=128, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    arch, cfg, module = model_from_hf(path, dtype=jnp.float32)
+    assert arch == "gpt2" and cfg.intermediate_size == 96
+    params = load_hf_checkpoint(path, dtype=jnp.float32)
+    ids = np.random.default_rng(25).integers(0, 256, size=(2, 10),
+                                             dtype=np.int64)
+    ours = np.asarray(module.apply({"params": params},
+                                   jnp.asarray(ids, jnp.int32)))
+    theirs = _hf_logits(hf, ids)
+    np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=1e-3)
+
+
 def test_opt_logits_match_hf(tmp_path):
     hf_cfg = transformers.OPTConfig(
         vocab_size=256, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
@@ -395,6 +415,28 @@ def test_gptj_logits_match_hf(tmp_path):
     assert arch == "gptj" and cfg.rotary_dim == 8
     params = load_hf_checkpoint(path, dtype=jnp.float32)
     ids = np.random.default_rng(22).integers(0, 256, size=(2, 13),
+                                             dtype=np.int64)
+    ours = np.asarray(module.apply({"params": params},
+                                   jnp.asarray(ids, jnp.int32)))
+    theirs = _hf_logits(hf, ids)
+    np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=1e-3)
+
+
+def test_gptj_nondefault_n_inner_loads_and_matches(tmp_path):
+    """HF ``n_inner`` (non-default MLP width) must reach
+    GPTJConfig.intermediate_size — previously the 4x width was hardcoded
+    and such checkpoints shape-errored on fc_in."""
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, rotary_dim=8,
+        n_inner=96, n_positions=128, resid_pdrop=0.0, embd_pdrop=0.0,
+        attn_pdrop=0.0)
+    hf = transformers.GPTJForCausalLM(hf_cfg)
+    path = _save(tmp_path, hf, hf_cfg)
+
+    arch, cfg, module = model_from_hf(path, dtype=jnp.float32)
+    assert arch == "gptj" and cfg.intermediate_size == 96
+    params = load_hf_checkpoint(path, dtype=jnp.float32)
+    ids = np.random.default_rng(24).integers(0, 256, size=(2, 11),
                                              dtype=np.int64)
     ours = np.asarray(module.apply({"params": params},
                                    jnp.asarray(ids, jnp.int32)))
