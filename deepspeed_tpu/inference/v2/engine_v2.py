@@ -559,6 +559,43 @@ class InferenceEngineV2:
         self._dev_decode_state = None
 
     # ------------------------------------------------------------------ #
+    # Preemption support (the serving scheduler's KV-pressure path):
+    # flush_to_host releases a sequence's device blocks but hands back a
+    # host snapshot, and resume() re-admits by RECOMPUTE — re-prefilling
+    # the full token history the caller kept host-side.  The engine never
+    # stores token ids (they only pass through ``pending``), so the
+    # snapshot carries bookkeeping, not tokens; under greedy decoding the
+    # recomputed KV is bit-identical in effect and generation continues
+    # token-for-token as if never preempted.
+    # ------------------------------------------------------------------ #
+    def flush_to_host(self, uids: Sequence[int]) -> Dict[int, Dict[str, int]]:
+        """Release device KV for ``uids`` (preemption).  Returns per-uid
+        host snapshots ``{"seen_tokens", "pending_tokens"}`` — the caller
+        owns the token history and re-admits via :meth:`resume`."""
+        out: Dict[int, Dict[str, int]] = {}
+        for uid in uids:
+            seq = self.state_manager.get_sequence(uid)
+            if seq is None:
+                raise ValueError(f"flush_to_host: unknown sequence {uid}")
+            out[uid] = {"seen_tokens": seq.seen_tokens,
+                        "pending_tokens": len(seq.pending)}
+        self.flush(uids)
+        return out
+
+    def resume(self, uid: int, tokens: Sequence[int],
+               sync: bool = True) -> Dict[int, np.ndarray]:
+        """Re-admit a flushed sequence by recompute: re-prefill its full
+        token history (prompt + tokens generated before preemption) and
+        return the last token's logits, exactly as :meth:`put` would.
+        The sequence must not be live (it was flushed by
+        :meth:`flush_to_host`)."""
+        if self.state_manager.get_sequence(uid) is not None:
+            raise RuntimeError(
+                f"resume: sequence {uid} is still live — it was never "
+                f"flushed, or the uid was reused")
+        return self.put([uid], [tokens], sync=sync)
+
+    # ------------------------------------------------------------------ #
     # serialize (reference engine_v2.py:237 + flat_model_helpers.py —
     # flattened inference checkpoints: one contiguous payload + a metadata
     # manifest, so a serving replica restores with a single sequential

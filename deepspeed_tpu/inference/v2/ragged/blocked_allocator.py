@@ -20,6 +20,10 @@ class BlockedAllocator:
             raise ValueError("need at least 2 blocks (one is the trash block)")
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(1, num_blocks))  # 0 reserved
+        # companion set: O(1) membership for the double-free check (the
+        # list scan is O(n) per block -> O(n^2) per batch flush at serving
+        # scale); the list still carries allocation ORDER
+        self._free_set = set(self._free)
 
     @property
     def free_blocks(self) -> int:
@@ -34,15 +38,20 @@ class BlockedAllocator:
                 f"KV cache exhausted: requested {num_blocks} blocks, "
                 f"{len(self._free)} free")
         out, self._free = self._free[:num_blocks], self._free[num_blocks:]
+        self._free_set.difference_update(out)
         return out
 
     def free(self, blocks: Iterable[int]) -> None:
         """reference ``free``: returns blocks to the free list."""
+        blocks = list(blocks)
+        seen = set()
         for b in blocks:
             if b == self.TRASH_BLOCK:
                 raise ValueError("cannot free the trash block")
             if not 0 < b < self.num_blocks:
                 raise ValueError(f"invalid block id {b}")
-            if b in self._free:
+            if b in self._free_set or b in seen:
                 raise ValueError(f"double free of block {b}")
+            seen.add(b)
         self._free.extend(blocks)
+        self._free_set.update(blocks)

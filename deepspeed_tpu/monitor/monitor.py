@@ -1,15 +1,28 @@
 """Monitoring fan-out (reference: monitor/monitor.py:29 ``MonitorMaster`` →
-TensorBoard / WandB / CSV writers)."""
+TensorBoard / WandB / CSV writers).
+
+An event's x value is either a training step (int) or a WALL-CLOCK
+timestamp (float seconds, e.g. ``time.time()``).  Serving-side series
+(``serving/*``) have no step counter — a float x lets them plot against
+real time instead of fabricating step numbers; each writer maps a float x
+onto its closest native notion of wall time.
+"""
 
 from __future__ import annotations
 
 import csv
 import os
-from typing import List, Optional, Tuple
+from typing import List, Tuple, Union
 
 from deepspeed_tpu.utils.logging import logger
 
-Event = Tuple[str, float, int]  # (name, value, step)
+#: (name, value, x) — x: int training step, or float wall-clock seconds
+Event = Tuple[str, float, Union[int, float]]
+
+
+def _is_wallclock(x) -> bool:
+    """Float x = wall-clock seconds; int (incl. np integer) = step."""
+    return isinstance(x, float)
 
 
 class Monitor:
@@ -40,7 +53,14 @@ class TensorBoardMonitor(Monitor):
         if self.writer is None:
             return
         for name, value, step in events:
-            self.writer.add_scalar(name, float(value), int(step))
+            if _is_wallclock(step):
+                # wall-clock series: the step axis is the integer second
+                # and the true float timestamp rides the walltime axis
+                # (TensorBoard's RELATIVE/WALL x-axis modes)
+                self.writer.add_scalar(name, float(value), int(step),
+                                       walltime=float(step))
+            else:
+                self.writer.add_scalar(name, float(value), int(step))
         self.writer.flush()
 
 
@@ -48,6 +68,9 @@ class WandbMonitor(Monitor):
     def __init__(self, config):
         super().__init__(config)
         self.run = None
+        self._wallclock_metrics = set()
+        self._seen_step_events = False
+        self._warned_mixed_axes = False
         if self.enabled:
             try:
                 import wandb  # type: ignore
@@ -64,7 +87,40 @@ class WandbMonitor(Monitor):
         import wandb  # type: ignore
 
         for name, value, step in events:
-            wandb.log({name: float(value)}, step=int(step))
+            if _is_wallclock(step):
+                # wandb has ONE monotone global step; wall-clock series
+                # instead plot against their own _walltime axis
+                # (define_metric), logged committed so every export is a
+                # real data point.  Caveat: each commit advances the
+                # global step, so do not interleave training-step events
+                # and wall-clock events through the SAME wandb run —
+                # give serving its own run/job.
+                if self._seen_step_events:
+                    self._warn_mixed_axes()
+                axis = f"{name}/_walltime"
+                if name not in self._wallclock_metrics:
+                    try:
+                        self.run.define_metric(name, step_metric=axis)
+                    except Exception:  # pragma: no cover — older wandb
+                        pass
+                    self._wallclock_metrics.add(name)
+                wandb.log({name: float(value), axis: float(step)})
+            else:
+                self._seen_step_events = True
+                if self._wallclock_metrics:     # mixed in either order
+                    self._warn_mixed_axes()
+                wandb.log({name: float(value)}, step=int(step))
+
+    def _warn_mixed_axes(self) -> None:
+        if self._warned_mixed_axes:
+            return
+        self._warned_mixed_axes = True
+        logger.warning(
+            "WandbMonitor: mixing wall-clock (serving/*) and "
+            "training-step events in one wandb run — each wall-clock "
+            "commit advances wandb's global step, so training points "
+            "with smaller explicit steps will be DROPPED by wandb. "
+            "Give serving metrics their own wandb run.")
 
 
 class CSVMonitor(Monitor):
@@ -87,7 +143,8 @@ class CSVMonitor(Monitor):
                 w = csv.writer(f)
                 if new:
                     w.writerow(["step", name])
-                w.writerow([int(step), float(value)])
+                w.writerow([float(step) if _is_wallclock(step)
+                            else int(step), float(value)])
 
 
 class MonitorMaster:

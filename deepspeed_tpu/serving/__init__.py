@@ -1,0 +1,24 @@
+"""Continuous-batching serving layer (reference: the DeepSpeed-MII request
+loop above FastGen — iteration-level Orca-style scheduling with Dynamic
+SplitFuse packing — as a first-class subsystem).
+
+Typical use::
+
+    from deepspeed_tpu.serving import (ContinuousBatchScheduler, Request,
+                                       SamplingParams)
+
+    sched = ContinuousBatchScheduler(engine)
+    req = sched.submit(prompt_tokens,
+                       sampling=SamplingParams(max_new_tokens=64))
+    sched.run_until_idle()
+    print(req.generated, req.ttft)
+"""
+
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.request import (Request, RequestState,
+                                           SamplingParams)
+from deepspeed_tpu.serving.sampler import sample_batch, sample_one
+from deepspeed_tpu.serving.scheduler import ContinuousBatchScheduler
+
+__all__ = ["ContinuousBatchScheduler", "Request", "RequestState",
+           "SamplingParams", "ServingMetrics", "sample_batch", "sample_one"]

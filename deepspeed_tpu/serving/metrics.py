@@ -1,0 +1,152 @@
+"""Per-request SLO metrics and aggregate serving telemetry.
+
+Tracks, per request: TTFT (arrival -> first token), TPOT (mean inter-token
+latency), queue wait (arrival -> first scheduled), and preemption count;
+and in aggregate: p50/p95 percentiles plus rolling tokens/s goodput
+(completed-request tokens only — tokens thrown away by preemption recompute
+don't count, which is what makes it goodput rather than throughput).
+
+``export()`` pushes ``serving/*`` scalars through the existing
+:class:`~deepspeed_tpu.monitor.monitor.MonitorMaster` fan-out
+(TensorBoard / WandB / CSV).  Serving has no training step counter, so
+events carry a WALL-CLOCK x value (float seconds) — the monitor writers
+accept float steps for exactly this (see monitor.py ``Event``).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.serving.request import Request
+
+
+def _pct(values: List[float], q: float) -> float:
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+class ServingMetrics:
+    """Aggregates request lifecycles into SLO telemetry.
+
+    The scheduler calls the ``record_*`` hooks; everything derived (TTFT,
+    TPOT, queue wait) is read off the :class:`Request`'s own timestamps so
+    there is exactly one source of per-request truth.
+    """
+
+    def __init__(self, monitor=None, window_s: float = 10.0):
+        self.monitor = monitor
+        self.window_s = window_s
+        self.started = time.monotonic()
+        self.submitted = 0
+        self.finished = 0
+        self.failed = 0
+        self.preemptions = 0
+        self.preempted_requests = 0      # ever preempted (incl. in-flight)
+        self._terminal_preempted = 0     # preempted AND reached a terminal state
+        self.total_tokens = 0            # tokens of FINISHED requests only
+        self.ttft_s: List[float] = []
+        self.tpot_s: List[float] = []
+        self.queue_wait_s: List[float] = []
+        #: (emit time, 1) per goodput-counted token, for the rolling rate
+        self._token_times: Deque[float] = deque()
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle hooks
+    # ------------------------------------------------------------------ #
+    def record_submit(self, req: Request) -> None:
+        self.submitted += 1
+
+    def record_preemption(self, req: Request) -> None:
+        self.preemptions += 1
+        if req.preemptions == 1:
+            self.preempted_requests += 1
+
+    def record_finish(self, req: Request) -> None:
+        now = time.monotonic()
+        req.finish_time = now
+        if req.preemptions > 0:
+            self._terminal_preempted += 1
+        if req.state.value == "failed":
+            self.failed += 1
+            return
+        self.finished += 1
+        self.total_tokens += len(req.generated)
+        if req.ttft is not None:
+            self.ttft_s.append(req.ttft)
+        if req.tpot is not None:
+            self.tpot_s.append(req.tpot)
+        if req.queue_wait is not None:
+            self.queue_wait_s.append(req.queue_wait)
+        # goodput counts a finished request's tokens at completion time
+        self._token_times.extend([now] * len(req.generated))
+        self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._token_times and self._token_times[0] < cutoff:
+            self._token_times.popleft()
+
+    # ------------------------------------------------------------------ #
+    # Aggregates
+    # ------------------------------------------------------------------ #
+    def goodput_tokens_per_s(self) -> float:
+        """Rolling tokens/s over the last ``window_s`` seconds (finished
+        requests' tokens only)."""
+        now = time.monotonic()
+        self._trim(now)
+        span = min(self.window_s, max(now - self.started, 1e-9))
+        return len(self._token_times) / span
+
+    def overall_tokens_per_s(self) -> float:
+        span = max(time.monotonic() - self.started, 1e-9)
+        return self.total_tokens / span
+
+    def preemption_rate(self) -> float:
+        """Fraction of terminal (finished or failed) requests that were
+        preempted at least once — bounded to [0, 1] by construction
+        (in-flight preempted requests don't enter the numerator until
+        they terminate)."""
+        return self._terminal_preempted / max(self.finished + self.failed, 1)
+
+    def snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = {
+            "submitted": float(self.submitted),
+            "finished": float(self.finished),
+            "failed": float(self.failed),
+            "preemptions": float(self.preemptions),
+            "preempted_requests": float(self.preempted_requests),
+            "preemption_rate": self.preemption_rate(),
+            "total_tokens": float(self.total_tokens),
+            "goodput_tokens_per_s": self.goodput_tokens_per_s(),
+            "overall_tokens_per_s": self.overall_tokens_per_s(),
+        }
+        for name, vals in (("ttft_s", self.ttft_s),
+                           ("tpot_s", self.tpot_s),
+                           ("queue_wait_s", self.queue_wait_s)):
+            if vals:
+                out[f"p50_{name}"] = _pct(vals, 50)
+                out[f"p95_{name}"] = _pct(vals, 95)
+        return out
+
+    # ------------------------------------------------------------------ #
+    # Monitor fan-out
+    # ------------------------------------------------------------------ #
+    def export(self, monitor=None,
+               now: Optional[float] = None) -> List[Tuple[str, float, float]]:
+        """Emit ``serving/*`` scalars through the monitor writers.
+
+        The x value is wall-clock ``time.time()`` (float) — no fabricated
+        step numbers; the writers persist it as-is (CSV), or as the
+        TensorBoard walltime axis.  Returns the event list (also when no
+        monitor is attached, for callers that fan out themselves).
+        """
+        monitor = monitor if monitor is not None else self.monitor
+        wall = time.time() if now is None else now
+        events = [(f"serving/{k}", v, wall)
+                  for k, v in self.snapshot().items()]
+        if monitor is not None and getattr(monitor, "enabled", False):
+            monitor.write_events(events)
+        return events

@@ -1,0 +1,199 @@
+"""Serving request lifecycle (reference: the role MII's ``RaggedRequest`` /
+request tracking plays above the FastGen engine — deepspeed-mii
+batching/ragged_batching.py — recast as a host-side state machine the
+:class:`~deepspeed_tpu.serving.scheduler.ContinuousBatchScheduler` owns).
+
+A :class:`Request` is everything the scheduler needs to drive one user
+generation through :class:`InferenceEngineV2`: the prompt, sampling
+parameters, a priority, and the lifecycle state machine::
+
+    QUEUED -> PREFILL -> DECODE -> FINISHED
+                 ^  \\        \\-> PREEMPTED -> (resume) PREFILL
+                 |   \\-> FAILED
+                 \\-- admission
+
+On preemption the request's KV blocks are flushed device-side; the prompt
+AND every generated token stay host-side on the request, so resumption is
+recompute (re-prefill ``prompt + generated``) — greedy output is therefore
+token-for-token identical to an unpreempted run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"        # submitted, no engine state yet
+    PREFILL = "prefill"      # admitted; prompt (or recompute) chunks in flight
+    DECODE = "decode"        # prompt consumed; generating one token per tick
+    PREEMPTED = "preempted"  # KV flushed under pressure; awaiting re-admission
+    FINISHED = "finished"    # terminal: stop token / length reached
+    FAILED = "failed"        # terminal: could never be scheduled
+
+
+#: Legal state-machine edges (from -> to). Anything else is a scheduler bug.
+_TRANSITIONS = {
+    RequestState.QUEUED: {RequestState.PREFILL, RequestState.FAILED},
+    RequestState.PREFILL: {RequestState.DECODE, RequestState.PREEMPTED,
+                           RequestState.FINISHED, RequestState.FAILED},
+    RequestState.DECODE: {RequestState.DECODE, RequestState.PREEMPTED,
+                          RequestState.FINISHED, RequestState.FAILED},
+    RequestState.PREEMPTED: {RequestState.PREFILL, RequestState.FINISHED,
+                             RequestState.FAILED},
+    RequestState.FINISHED: set(),
+    RequestState.FAILED: set(),
+}
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request sampling (greedy / temperature / top-k).
+
+    ``seed`` keys the noise stream together with the request uid and the
+    generation position: the token drawn at position ``i`` depends only on
+    (seed, uid, i, logits), so a preempt/recompute resume reproduces the
+    same continuation, and requests sharing a ``SamplingParams`` still
+    draw independently.
+    """
+
+    greedy: bool = True
+    temperature: float = 1.0
+    top_k: int = 0                       # 0 -> full vocab
+    max_new_tokens: int = 16
+    eos_token_id: Optional[int] = None
+    stop_token_ids: Tuple[int, ...] = ()
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if not self.greedy and self.temperature <= 0.0:
+            raise ValueError("temperature must be > 0 when sampling")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+
+    def is_stop_token(self, token: int) -> bool:
+        return (token in self.stop_token_ids
+                or (self.eos_token_id is not None
+                    and token == self.eos_token_id))
+
+
+@dataclasses.dataclass(eq=False)
+class Request:
+    """One user generation request plus its scheduler-side bookkeeping.
+
+    ``eq=False``: requests are identity objects (the scheduler keeps them
+    in lists/dicts); two requests are never "equal" by field values.
+    """
+
+    uid: int
+    prompt: List[int]
+    sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    priority: int = 0                    # higher = preempted later
+    arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+    #: called as ``on_token(request, token)`` for every emitted token
+    #: (streaming hook).  A raising callback is disabled and logged, not
+    #: propagated — one client's broken stream handler must not corrupt
+    #: the whole batch's scheduling state mid-tick
+    on_token: Optional[Callable[["Request", int], None]] = None
+
+    # -- lifecycle ---------------------------------------------------- #
+    state: RequestState = RequestState.QUEUED
+    generated: List[int] = dataclasses.field(default_factory=list)
+    #: tokens of ``history`` whose KV lives on device (engine seen_tokens)
+    fed: int = 0
+    finish_reason: Optional[str] = None
+    #: admission order stamp (scheduler-assigned; preemption tie-break)
+    admitted_at: int = -1
+
+    # -- per-request SLO accounting (wall-clock, time.monotonic) ------- #
+    first_scheduled_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+    last_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    preemptions: int = 0
+
+    def __post_init__(self):
+        if not self.prompt:
+            raise ValueError(f"request {self.uid}: empty prompt")
+
+    # ------------------------------------------------------------------ #
+    @property
+    def history(self) -> List[int]:
+        """Full token history the engine must hold KV for: the prompt plus
+        every generated token (the recompute-resume unit)."""
+        return self.prompt + self.generated
+
+    @property
+    def remaining_feed(self) -> int:
+        """Tokens of ``history`` not yet consumed by the engine.  1 means a
+        plain decode step; >1 means (re)prefill chunks are outstanding."""
+        return len(self.history) - self.fed
+
+    @property
+    def is_running(self) -> bool:
+        return self.state in (RequestState.PREFILL, RequestState.DECODE)
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.FAILED)
+
+    def transition(self, new_state: RequestState) -> None:
+        if new_state not in _TRANSITIONS[self.state]:
+            raise RuntimeError(
+                f"request {self.uid}: illegal transition "
+                f"{self.state.value} -> {new_state.value}")
+        self.state = new_state
+
+    # ------------------------------------------------------------------ #
+    def emit(self, token: int, now: float) -> None:
+        """Record one generated token (and stream it)."""
+        self.generated.append(int(token))
+        if self.first_token_time is None:
+            self.first_token_time = now
+        self.last_token_time = now
+        if self.on_token is not None:
+            try:
+                self.on_token(self, int(token))
+            except Exception:  # noqa: BLE001
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.exception(
+                    f"request {self.uid}: on_token callback raised — "
+                    f"disabling streaming for this request")
+                self.on_token = None
+
+    def should_stop(self) -> Optional[str]:
+        """Termination check after the latest emit: reason or None."""
+        if self.generated and self.sampling.is_stop_token(self.generated[-1]):
+            return "stop"
+        if len(self.generated) >= self.sampling.max_new_tokens:
+            return "length"
+        return None
+
+    # -- derived SLO metrics ------------------------------------------- #
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.arrival_time
+
+    @property
+    def queue_wait(self) -> Optional[float]:
+        if self.first_scheduled_time is None:
+            return None
+        return self.first_scheduled_time - self.arrival_time
+
+    @property
+    def tpot(self) -> Optional[float]:
+        """Mean per-token latency AFTER the first token (time-per-output-
+        token, the decode-side SLO)."""
+        if (self.first_token_time is None or self.last_token_time is None
+                or len(self.generated) < 2):
+            return None
+        span = self.last_token_time - self.first_token_time
+        return span / (len(self.generated) - 1)

@@ -1,0 +1,405 @@
+"""Iteration-level continuous-batching scheduler (reference: the Orca-style
+request loop DeepSpeed-MII runs above the FastGen engine —
+mii/batching/ragged_batching.py ``schedule_requests`` — with Dynamic
+SplitFuse packing per blogs/deepspeed-fastgen).
+
+Each :meth:`ContinuousBatchScheduler.step` packs exactly one engine forward
+under the fixed token budget:
+
+1. every running DECODE sequence first (one token each) — decode latency is
+   the SLO, so decodes are never displaced by prefill work;
+2. then SplitFuse prefill chunks — mid-prefill continuations, preempted
+   requests being resumed (recompute), and new admissions — each sized by
+   binary search against ``engine.can_schedule()`` to fill the remaining
+   budget without overcommitting KV blocks or sequence slots.
+
+KV pressure: when the decode set itself no longer fits (every decode token
+may need a fresh block), the scheduler preempts the lowest-priority /
+most-recently-admitted running request — ``engine.flush_to_host()`` drops
+its device blocks, the prompt + generated tokens stay host-side on the
+:class:`Request`, and it re-admits later by recompute (re-prefilling
+``prompt + generated``), which under greedy sampling reproduces the exact
+unpreempted continuation.
+
+Everything here is host-side python; device work is the engine's single
+jitted ragged step — the same split the reference keeps.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.request import (Request, RequestState,
+                                           SamplingParams)
+from deepspeed_tpu.serving.sampler import sample_batch
+from deepspeed_tpu.utils.logging import logger
+
+
+class ContinuousBatchScheduler:
+    """Owns the request lifecycle between user ``submit()`` calls and
+    :class:`~deepspeed_tpu.inference.v2.engine_v2.InferenceEngineV2`."""
+
+    def __init__(self, engine, monitor=None,
+                 metrics: Optional[ServingMetrics] = None,
+                 export_every: int = 0):
+        self.engine = engine
+        sm_cfg = engine.config.state_manager
+        self.token_budget = sm_cfg.max_ragged_batch_size
+        self.max_seqs = sm_cfg.max_ragged_sequence_count
+        self.max_context = sm_cfg.max_context
+        self.metrics = metrics if metrics is not None \
+            else ServingMetrics(monitor)
+        #: export serving/* scalars through the monitor every N ticks
+        #: (0 = only on run_until_idle/drain completion)
+        self.export_every = export_every
+        self._queued: List[Request] = []
+        self._running: Dict[int, Request] = {}
+        self._preempted: List[Request] = []
+        self._finished: List[Request] = []
+        self._uid_counter = itertools.count(1)
+        self._admit_counter = itertools.count()
+        self._tick = 0
+
+    # ------------------------------------------------------------------ #
+    # Submission
+    # ------------------------------------------------------------------ #
+    def submit(self, prompt: Optional[Sequence[int]] = None,
+               sampling: Optional[SamplingParams] = None,
+               priority: int = 0, uid: Optional[int] = None,
+               on_token=None, request: Optional[Request] = None) -> Request:
+        """Enqueue one generation request; returns the tracked
+        :class:`Request` (read its ``state``/``generated`` as it runs)."""
+        if request is None:
+            if prompt is None:
+                raise ValueError("submit: prompt or request required")
+            if uid is None:
+                # auto uids skip anything live (a caller-supplied uid may
+                # have claimed a counter value)
+                uid = next(self._uid_counter)
+                while self._is_tracked_uid(uid):
+                    uid = next(self._uid_counter)
+            request = Request(
+                uid=uid,
+                prompt=[int(t) for t in prompt],
+                sampling=sampling or SamplingParams(),
+                priority=priority, on_token=on_token)
+        if request.state is not RequestState.QUEUED:
+            raise ValueError(f"submit: request {request.uid} already "
+                             f"{request.state.value}")
+        if self._is_tracked_uid(request.uid):
+            raise ValueError(f"submit: uid {request.uid} already live")
+        if len(request.prompt) + 1 > self.max_context:
+            raise ValueError(
+                f"submit: prompt of {len(request.prompt)} tokens cannot fit "
+                f"max_context {self.max_context} with room to generate")
+        sm = self.engine.state_manager
+        prompt_blocks = -(-(len(request.prompt) + 1) // sm.block_size)
+        if prompt_blocks > sm.allocator.num_blocks - 1:
+            raise ValueError(
+                f"submit: prompt needs {prompt_blocks} KV blocks but the "
+                f"pool only has {sm.allocator.num_blocks - 1} usable")
+        self._queued.append(request)
+        self.metrics.record_submit(request)
+        return request
+
+    def _is_tracked_uid(self, uid: int) -> bool:
+        return (uid in self._running
+                or any(r.uid == uid for r in self._queued)
+                or any(r.uid == uid for r in self._preempted))
+
+    # ------------------------------------------------------------------ #
+    # State inspection
+    # ------------------------------------------------------------------ #
+    @property
+    def num_pending(self) -> int:
+        """Requests not yet in a terminal state."""
+        return len(self._queued) + len(self._running) + len(self._preempted)
+
+    @property
+    def finished_requests(self) -> List[Request]:
+        return list(self._finished)
+
+    @property
+    def running_uids(self) -> List[int]:
+        return list(self._running)
+
+    # ------------------------------------------------------------------ #
+    # One scheduling tick
+    # ------------------------------------------------------------------ #
+    def step(self) -> List[Tuple[Request, int]]:
+        """Pack one engine forward and sample its logits.  Returns the
+        ``(request, token)`` pairs emitted this tick."""
+        self._reap_unservable()
+        uids: List[int] = []
+        chunks: List[List[int]] = []
+        packed: List[Request] = []
+
+        self._pack_decodes(uids, chunks, packed)
+        self._pack_prefills(uids, chunks, packed)
+
+        if not uids:
+            self._handle_stall()
+            return []
+
+        now = time.monotonic()
+        for req in packed:
+            if req.first_scheduled_time is None:
+                req.first_scheduled_time = now
+        logits = self.engine.put(uids, chunks, sync=True)
+        for req, chunk in zip(packed, chunks):
+            req.fed += len(chunk)
+
+        emitted = self._sample_and_advance(packed, logits)
+        self._tick += 1
+        if self.export_every and self._tick % self.export_every == 0:
+            self.metrics.export()
+        return emitted
+
+    # -- packing ------------------------------------------------------- #
+    def _pack_decodes(self, uids, chunks, packed) -> None:
+        """All running decode sequences, one token each; preempt under KV
+        pressure until the set fits."""
+        decodes = sorted(
+            (r for r in self._running.values() if r.remaining_feed == 1),
+            key=lambda r: r.admitted_at)
+        while decodes:
+            cand_uids = [r.uid for r in decodes]
+            if self.engine.can_schedule(cand_uids, [1] * len(cand_uids)):
+                break
+            victim = self._pick_victim()
+            self._preempt(victim)
+            decodes = [r for r in decodes if r.uid != victim.uid]
+        for r in decodes:
+            uids.append(r.uid)
+            chunks.append([r.history[-1]])
+            packed.append(r)
+
+    def _pack_prefills(self, uids, chunks, packed) -> None:
+        """SplitFuse: fill the remaining budget with prefill chunks —
+        running mid-prefill first, then preempted resumes, then new
+        admissions (priority, then FIFO)."""
+        budget_left = self.token_budget - sum(len(c) for c in chunks)
+        mid = sorted((r for r in self._running.values()
+                      if r.remaining_feed > 1 and r not in packed),
+                     key=lambda r: r.admitted_at)
+        resumes = sorted(self._preempted,
+                         key=lambda r: (-r.priority, r.arrival_time))
+        fresh = sorted(self._queued,
+                       key=lambda r: (-r.priority, r.arrival_time))
+        for req in itertools.chain(mid, resumes, fresh):
+            if budget_left <= 0 or len(uids) >= self.max_seqs:
+                break
+            admitting = req.state in (RequestState.QUEUED,
+                                      RequestState.PREEMPTED)
+            if admitting and len(self._running) + 1 > self.max_seqs:
+                continue   # running set must stay one-forward-sized
+            want = min(req.remaining_feed, budget_left,
+                       self.max_context - req.fed)
+            chunk = self._max_feasible_chunk(uids, chunks, req.uid, want)
+            if chunk <= 0:
+                if admitting:
+                    break  # KV full: later (lower-priority) queue entries
+                           # can't fit either — don't starve order
+                continue
+            if admitting:
+                self._admit(req)
+            hist = req.history
+            uids.append(req.uid)
+            chunks.append(hist[req.fed:req.fed + chunk])
+            packed.append(req)
+            budget_left -= chunk
+
+    def _max_feasible_chunk(self, uids, chunks, uid: int, want: int) -> int:
+        """Largest chunk <= want that ``can_schedule`` accepts alongside
+        the already-packed set (binary search: feasibility is monotone)."""
+        if want <= 0:
+            return 0
+        lens = [len(c) for c in chunks]
+        lo, hi = 0, want
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if self.engine.can_schedule(uids + [uid], lens + [mid]):
+                lo = mid
+            else:
+                hi = mid - 1
+        return lo
+
+    # -- admission / preemption ---------------------------------------- #
+    def _admit(self, req: Request) -> None:
+        if req.state is RequestState.QUEUED:
+            self._queued.remove(req)
+        else:
+            self._preempted.remove(req)
+        req.transition(RequestState.PREFILL)
+        req.admitted_at = next(self._admit_counter)
+        self._running[req.uid] = req
+
+    def _pick_victim(self) -> Request:
+        """Lowest priority, then most recently admitted."""
+        if not self._running:
+            raise RuntimeError("no running request to preempt")
+        return min(self._running.values(),
+                   key=lambda r: (r.priority, -r.admitted_at))
+
+    def _preempt(self, req: Request) -> None:
+        self.engine.flush_to_host([req.uid])
+        del self._running[req.uid]
+        req.fed = 0
+        req.preemptions += 1
+        req.transition(RequestState.PREEMPTED)
+        self._preempted.append(req)
+        self.metrics.record_preemption(req)
+        logger.debug(f"serving: preempted request {req.uid} "
+                     f"({len(req.generated)} tokens generated)")
+
+    def _fail(self, req: Request, reason: str) -> None:
+        if req.uid in self._running:
+            self.engine.flush([req.uid])
+            del self._running[req.uid]
+        if req in self._queued:
+            self._queued.remove(req)
+        if req in self._preempted:
+            self._preempted.remove(req)
+        req.finish_reason = reason
+        req.transition(RequestState.FAILED)
+        self._finished.append(req)
+        self.metrics.record_finish(req)
+        logger.warning(f"serving: request {req.uid} failed: {reason}")
+
+    def _reap_unservable(self) -> None:
+        """Terminate requests whose token history has outgrown the ENTIRE
+        KV pool: they can never feed again, alone or otherwise.  Without
+        this guard a decode at the pool boundary enters an infinite
+        preempt -> recompute -> preempt cycle.  Generated tokens are kept
+        (FINISHED, truncated by capacity); a request that never produced
+        a token fails instead."""
+        sm = self.engine.state_manager
+        usable = sm.allocator.num_blocks - 1          # trash block reserved
+        for req in [*self._running.values(), *self._preempted]:
+            if -(-len(req.history) // sm.block_size) <= usable:
+                continue
+            if req.uid in self._running:
+                self.engine.flush([req.uid])
+                del self._running[req.uid]
+            else:
+                self._preempted.remove(req)
+            if req.generated:
+                req.finish_reason = "length"
+                req.transition(RequestState.FINISHED)
+            else:
+                req.finish_reason = "kv_capacity"
+                req.transition(RequestState.FAILED)
+            self._finished.append(req)
+            self.metrics.record_finish(req)
+            logger.warning(
+                f"serving: request {req.uid} truncated — history of "
+                f"{len(req.history)} tokens exceeds the {usable}-block "
+                f"KV pool")
+
+    def _handle_stall(self) -> None:
+        """Nothing could be packed.  With two or more running requests
+        this is a recoverable mid-prefill deadlock (they jointly hold the
+        pool, none can extend): preempt one — its blocks let the others
+        finish, and it resumes by recompute.  A SINGLE stalled holder (or
+        a stall with nothing running) can never fit and is failed rather
+        than spun on; _reap_unservable catches the history-outgrew-pool
+        case before it reaches here."""
+        if len(self._running) > 1:
+            self._preempt(self._pick_victim())
+        elif self._running:
+            self._fail(self._pick_victim(), "kv_capacity")
+        elif self._preempted:
+            self._fail(self._preempted[0], "kv_capacity")
+        elif self._queued:
+            self._fail(self._queued[0], "kv_capacity")
+
+    # -- sampling / lifecycle advance ---------------------------------- #
+    def _sample_and_advance(self, packed, logits) -> List[Tuple[Request, int]]:
+        ready = [r for r in packed if r.remaining_feed == 0]
+        if not ready:
+            return []
+        rows = np.stack([np.asarray(logits[r.uid], np.float32)
+                         for r in ready])
+        tokens = sample_batch(rows, [r.sampling for r in ready],
+                              [len(r.generated) for r in ready],
+                              [r.uid for r in ready])
+        now = time.monotonic()
+        emitted: List[Tuple[Request, int]] = []
+        for req, tok in zip(ready, tokens.tolist()):
+            req.emit(tok, now)
+            emitted.append((req, tok))
+            reason = req.should_stop()
+            if reason is None and len(req.history) >= self.max_context:
+                reason = "length"
+            if reason is not None:
+                self._finish(req, reason)
+            elif req.state is RequestState.PREFILL:
+                req.transition(RequestState.DECODE)
+        return emitted
+
+    def _finish(self, req: Request, reason: str) -> None:
+        self.engine.flush([req.uid])
+        del self._running[req.uid]
+        req.finish_reason = reason
+        req.transition(RequestState.FINISHED)
+        self._finished.append(req)
+        self.metrics.record_finish(req)
+
+    # ------------------------------------------------------------------ #
+    # Driving loops
+    # ------------------------------------------------------------------ #
+    def run_until_idle(self, max_ticks: Optional[int] = None) -> List[Request]:
+        """Step until every submitted request reaches a terminal state
+        (or ``max_ticks``).  Returns all finished/failed requests so far."""
+        ticks = 0
+        while self.num_pending:
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+            self.step()
+            ticks += 1
+        self.metrics.export()
+        return self.finished_requests
+
+    def run_with_arrivals(self, prompts, arrivals, sampling=None,
+                          priority: int = 0,
+                          poll_s: float = 0.005) -> List[Request]:
+        """Open-loop arrival driver: submit ``prompts[i]`` once
+        ``arrivals[i]`` seconds of wall clock have elapsed, stepping the
+        scheduler between arrivals until everything terminates.  Used by
+        the Poisson benches (``bench_serving.py --scheduler``) and the
+        tier-1 smoke.  ``sampling`` is one :class:`SamplingParams` shared
+        by all requests, or a per-request sequence."""
+        n = len(prompts)
+        per_req = isinstance(sampling, (list, tuple))
+        reqs: List[Request] = []
+        t0 = time.monotonic()
+        while len(reqs) < n or self.num_pending:
+            now = time.monotonic() - t0
+            while len(reqs) < n and arrivals[len(reqs)] <= now:
+                i = len(reqs)
+                reqs.append(self.submit(
+                    prompts[i],
+                    sampling=sampling[i] if per_req else sampling,
+                    priority=priority))
+            if self.num_pending:
+                self.step()
+            elif len(reqs) < n:
+                time.sleep(min(arrivals[len(reqs)] - now, poll_s))
+        return reqs
+
+    def drain(self, deadline: float) -> bool:
+        """Async-friendly bounded drain: step until idle or ``deadline``
+        seconds of wall clock elapse, then return control to the caller
+        (an event loop can interleave submits between drains).  Returns
+        True when fully idle."""
+        end = time.monotonic() + deadline
+        while self.num_pending and time.monotonic() < end:
+            self.step()
+        if not self.num_pending:
+            self.metrics.export()
+        return self.num_pending == 0

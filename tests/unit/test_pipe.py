@@ -555,6 +555,36 @@ def test_pipeline_1f1b_depth_parity_s8_m16():
     np.testing.assert_allclose(f1b_losses, gpipe_losses, rtol=2e-5)
 
 
+@pytest.mark.skipif(not hasattr(jax, "shard_map"),
+                    reason="needs jax.shard_map (newer jax)")
+def test_pipeline_1f1b_loss_depth_invariant():
+    """Depth parity for the masked stage!=0 embedding gather: the mask is
+    dead code on stage 0 and discarded everywhere else, so training the
+    SAME params/global batches at S=2 and S=4 must produce identical
+    losses — pipeline depth is an execution detail, not a math change.
+    (micro batch size scales with 1/dp so the global batch is fixed.)"""
+    batches = make_batches(4, 16, 8, seed=9)
+    stacked0 = tuple(np.stack([np.asarray(mb[i]) for mb in batches])
+                     for i in range(2))
+
+    def losses_at(pp, params0=None):
+        groups.reset()
+        topo = groups.initialize_mesh(pipe_parallel_size=pp,
+                                      data_parallel_size=8 // pp)
+        cfg = {**CFG, "train_micro_batch_size_per_gpu": 16 // (8 // pp)}
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=make_module(n_blocks=4), config=cfg, topology=topo,
+            model_parameters=params0, pipe_schedule="1f1b")
+        if params0 is None:
+            eng.initialize_parameters(*stacked0)
+        p0 = jax.device_get(eng.state["master"])
+        return _train(eng, 3, batches), p0
+
+    l2, params0 = losses_at(2)
+    l4, _ = losses_at(4, params0)
+    np.testing.assert_allclose(l4, l2, rtol=2e-5)
+
+
 def test_pipeline_default_schedule_is_1f1b():
     topo = groups.initialize_mesh(pipe_parallel_size=2,
                                   data_parallel_size=4)

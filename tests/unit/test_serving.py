@@ -1,0 +1,594 @@
+"""Serving-layer tests: request lifecycle, batched sampling, SplitFuse
+packing/admission boundaries, KV-pressure preemption with recompute-resume
+parity, termination, allocator hardening, metrics/monitor plumbing, and
+the 30-second smoke tool.
+
+Reference pattern: tests/unit/inference/v2/ragged plus the MII batching
+tests — correctness bar is token-for-token parity with an unscheduled
+(one-request-at-a-time) greedy loop on the same engine params.
+"""
+
+import importlib.util
+import pathlib
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
+from deepspeed_tpu.inference.v2.ragged import BlockedAllocator
+from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.serving import (ContinuousBatchScheduler, Request,
+                                   RequestState, SamplingParams,
+                                   sample_batch)
+
+CFG = LlamaConfig.tiny(dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return LlamaForCausalLM(CFG).init(
+        jax.random.key(0), np.zeros((1, 4), np.int32))["params"]
+
+
+def _engine(params, token_budget=32, block_size=8, max_context=64,
+            max_seqs=4, num_blocks=None):
+    cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": token_budget,
+                          "max_ragged_sequence_count": max_seqs,
+                          "max_context": max_context},
+        "kv_cache": {"block_size": block_size,
+                     **({"num_blocks": num_blocks}
+                        if num_blocks is not None else {})},
+    })
+    return InferenceEngineV2(RaggedLlama(CFG, block_size), params, cfg)
+
+
+def _greedy_reference(params, prompts, n_new):
+    """Unscheduled one-at-a-time greedy loop (put + host argmax) — the
+    token-for-token bar every scheduler run must meet."""
+    eng = _engine(params, token_budget=64, max_context=64)
+    outs = []
+    for i, p in enumerate(prompts):
+        uid = 500 + i
+        logits = eng.put([uid], [list(p)])
+        tok = int(np.argmax(logits[uid]))
+        toks = [tok]
+        for _ in range(n_new - 1):
+            logits = eng.put([uid], [[tok]])
+            tok = int(np.argmax(logits[uid]))
+            toks.append(tok)
+        eng.flush([uid])
+        outs.append(toks)
+    return outs
+
+
+# --------------------------------------------------------------------- #
+# Request lifecycle state machine
+# --------------------------------------------------------------------- #
+def test_request_state_machine():
+    r = Request(uid=1, prompt=[1, 2, 3])
+    assert r.state is RequestState.QUEUED
+    r.transition(RequestState.PREFILL)
+    r.transition(RequestState.DECODE)
+    r.transition(RequestState.PREEMPTED)
+    r.transition(RequestState.PREFILL)
+    r.transition(RequestState.FINISHED)
+    with pytest.raises(RuntimeError, match="illegal transition"):
+        r.transition(RequestState.DECODE)
+    with pytest.raises(RuntimeError, match="illegal transition"):
+        Request(uid=2, prompt=[1]).transition(RequestState.DECODE)
+
+
+def test_request_history_and_feed_accounting():
+    r = Request(uid=1, prompt=[5, 6, 7])
+    assert r.history == [5, 6, 7] and r.remaining_feed == 3
+    r.fed = 3
+    r.emit(9, now=1.0)
+    assert r.history == [5, 6, 7, 9] and r.remaining_feed == 1
+    assert r.first_token_time == 1.0
+
+
+def test_request_streaming_callback():
+    got = []
+    r = Request(uid=1, prompt=[1],
+                on_token=lambda req, tok: got.append((req.uid, tok)))
+    r.emit(4, now=0.0)
+    r.emit(5, now=0.1)
+    assert got == [(1, 4), (1, 5)] and r.generated == [4, 5]
+
+
+def test_raising_stream_callback_is_disabled_not_fatal(params):
+    """A broken on_token handler must not corrupt the tick for other
+    requests: the callback is disabled, generation completes."""
+    rng = np.random.default_rng(15)
+    prompts = [rng.integers(0, 256, size=(5,)).tolist() for _ in range(2)]
+    calls = []
+
+    def bad(req, tok):
+        calls.append(tok)
+        raise RuntimeError("client went away")
+
+    sched = ContinuousBatchScheduler(_engine(params))
+    r_bad = sched.submit(prompts[0], sampling=SamplingParams(max_new_tokens=4),
+                         on_token=bad)
+    r_ok = sched.submit(prompts[1], sampling=SamplingParams(max_new_tokens=4))
+    sched.run_until_idle()
+    assert r_bad.state is RequestState.FINISHED
+    assert r_ok.state is RequestState.FINISHED
+    assert len(r_bad.generated) == 4 and len(r_ok.generated) == 4
+    assert calls == r_bad.generated[:1]       # disabled after first raise
+    assert r_bad.on_token is None
+
+
+def test_sampling_params_validation():
+    with pytest.raises(ValueError):
+        SamplingParams(max_new_tokens=0)
+    with pytest.raises(ValueError):
+        SamplingParams(greedy=False, temperature=0.0)
+    with pytest.raises(ValueError):
+        SamplingParams(top_k=-1)
+    assert SamplingParams(eos_token_id=3).is_stop_token(3)
+    assert SamplingParams(stop_token_ids=(7,)).is_stop_token(7)
+    assert not SamplingParams().is_stop_token(7)
+
+
+# --------------------------------------------------------------------- #
+# Batched sampling
+# --------------------------------------------------------------------- #
+def test_sample_batch_greedy_is_argmax():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(5, 32)).astype(np.float32)
+    toks = sample_batch(logits, [SamplingParams()] * 5, [0] * 5,
+                        list(range(5)))
+    np.testing.assert_array_equal(toks, np.argmax(logits, axis=-1))
+
+
+def test_sample_batch_topk_support_and_determinism():
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(4, 64)).astype(np.float32)
+    sp = [SamplingParams(greedy=False, temperature=0.8, top_k=4, seed=s)
+          for s in range(4)]
+    toks = sample_batch(logits, sp, [3] * 4, [10, 11, 12, 13])
+    for i in range(4):
+        top4 = set(np.argsort(logits[i])[-4:].tolist())
+        assert int(toks[i]) in top4
+    # same (seed, uid, position) -> same draw, regardless of batch
+    # composition (the preempt/resume reproducibility contract)
+    again = sample_batch(logits[1:2], sp[1:2], [3], [11])
+    assert int(again[0]) == int(toks[1])
+    # a different position draws from a fresh stream
+    moved = sample_batch(np.tile(logits[1:2], (64, 1)), [sp[1]] * 64,
+                         list(range(64)), [11] * 64)
+    assert len(set(moved.tolist())) > 1
+
+
+def test_sample_batch_shared_seed_requests_draw_independently():
+    """Concurrent requests sharing one SamplingParams (and its seed) must
+    NOT produce identical streams — the uid is part of the noise key."""
+    rng = np.random.default_rng(14)
+    row = rng.normal(size=(1, 256)).astype(np.float32)
+    sp = SamplingParams(greedy=False, temperature=1.0, top_k=0, seed=0)
+    # same logits, same seed, same positions, different uids
+    toks = sample_batch(np.tile(row, (32, 1)), [sp] * 32, [0] * 32,
+                        list(range(32)))
+    assert len(set(toks.tolist())) > 1
+
+
+def test_sample_batch_mixed_greedy_and_stochastic():
+    rng = np.random.default_rng(2)
+    logits = rng.normal(size=(3, 16)).astype(np.float32)
+    sp = [SamplingParams(),
+          SamplingParams(greedy=False, temperature=0.5, top_k=2, seed=9),
+          SamplingParams()]
+    toks = sample_batch(logits, sp, [0, 0, 0], [1, 2, 3])
+    assert toks[0] == np.argmax(logits[0])
+    assert toks[2] == np.argmax(logits[2])
+    assert int(toks[1]) in set(np.argsort(logits[1])[-2:].tolist())
+
+
+# --------------------------------------------------------------------- #
+# Scheduler: completion + parity with the unscheduled loop
+# --------------------------------------------------------------------- #
+def test_scheduler_matches_unscheduled_greedy(params):
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, CFG.vocab_size, size=(n,)).tolist()
+               for n in (5, 11, 3)]
+    want = _greedy_reference(params, prompts, n_new=6)
+
+    sched = ContinuousBatchScheduler(_engine(params, token_budget=8))
+    # budget 8 < sum of prompts -> SplitFuse chunking across ticks
+    reqs = [sched.submit(p, sampling=SamplingParams(max_new_tokens=6))
+            for p in prompts]
+    sched.run_until_idle()
+    for r, w in zip(reqs, want):
+        assert r.state is RequestState.FINISHED
+        assert r.finish_reason == "length"
+        assert r.generated == w
+
+
+def test_scheduler_streaming_and_slo_fields(params):
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, CFG.vocab_size, size=(6,)).tolist()
+    streamed = []
+    sched = ContinuousBatchScheduler(_engine(params))
+    r = sched.submit(prompt, sampling=SamplingParams(max_new_tokens=5),
+                     on_token=lambda req, t: streamed.append(t))
+    sched.run_until_idle()
+    assert streamed == r.generated and len(streamed) == 5
+    assert r.ttft is not None and r.ttft >= 0
+    assert r.queue_wait is not None and r.queue_wait >= 0
+    assert r.tpot is not None and r.tpot >= 0
+    assert r.finish_time is not None
+
+
+# --------------------------------------------------------------------- #
+# Admission boundaries: exact token budget / max_seqs
+# --------------------------------------------------------------------- #
+def _spy_put(engine):
+    """Record every put()'s chunk lengths."""
+    calls = []
+    orig = engine.put
+
+    def spy(uids, tokens, sync=True):
+        calls.append([len(t) for t in tokens])
+        return orig(uids, tokens, sync=sync)
+
+    engine.put = spy
+    return calls
+
+
+def test_admission_exact_token_budget(params):
+    eng = _engine(params, token_budget=16, max_context=32)
+    calls = _spy_put(eng)
+    sched = ContinuousBatchScheduler(eng)
+    rng = np.random.default_rng(5)
+    # two 8-token prompts pack ONE forward at exactly the budget
+    reqs = [sched.submit(rng.integers(0, 256, size=(8,)).tolist(),
+                         sampling=SamplingParams(max_new_tokens=2))
+            for _ in range(2)]
+    sched.step()
+    assert calls[0] == [8, 8]
+    sched.run_until_idle()
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+    # a 17-token prompt must split 16 + 1 across ticks
+    calls.clear()
+    r = sched.submit(rng.integers(0, 256, size=(17,)).tolist(),
+                     sampling=SamplingParams(max_new_tokens=2))
+    sched.run_until_idle()
+    assert r.state is RequestState.FINISHED
+    assert calls[0] == [16] and calls[1][0] == 1
+    assert all(sum(c) <= 16 for c in calls)
+
+
+def test_admission_max_seqs_boundary(params):
+    sched = ContinuousBatchScheduler(
+        _engine(params, token_budget=64, max_seqs=2, max_context=32))
+    rng = np.random.default_rng(6)
+    reqs = [sched.submit(rng.integers(0, 256, size=(4,)).tolist(),
+                         sampling=SamplingParams(max_new_tokens=4))
+            for _ in range(5)]
+    while sched.num_pending:
+        sched.step()
+        assert len(sched.running_uids) <= 2
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+    assert all(len(r.generated) == 4 for r in reqs)
+
+
+def test_submit_rejections(params):
+    sched = ContinuousBatchScheduler(
+        _engine(params, max_context=32, num_blocks=3))
+    with pytest.raises(ValueError, match="max_context"):
+        sched.submit(list(range(32)))
+    # 2 usable blocks of 8 tokens; a 16-token prompt needs 3
+    with pytest.raises(ValueError, match="KV blocks"):
+        sched.submit([1] * 16)
+    with pytest.raises(ValueError, match="empty prompt"):
+        sched.submit([])
+    r = sched.submit([1, 2, 3])
+    with pytest.raises(ValueError, match="already"):
+        sched.submit([4, 5], uid=r.uid)
+
+
+# --------------------------------------------------------------------- #
+# KV exhaustion -> preempt -> resume: token-for-token greedy parity
+# (acceptance: >= 8 Poisson-arrival requests, >= 1 forced preemption)
+# --------------------------------------------------------------------- #
+def test_preemption_resume_greedy_parity(params):
+    rng = np.random.default_rng(7)
+    n_req, n_new = 8, 8
+    prompts = [rng.integers(0, CFG.vocab_size, size=(int(n),)).tolist()
+               for n in rng.integers(6, 16, size=n_req)]
+    want = _greedy_reference(params, prompts, n_new)
+
+    # 6 usable blocks of 8 tokens vs 8 requests needing up to 3 blocks
+    # each: concurrency is KV-bound, so preemption MUST occur
+    eng = _engine(params, token_budget=32, block_size=8, max_context=48,
+                  max_seqs=4, num_blocks=7)
+    sched = ContinuousBatchScheduler(eng)
+    # Poisson arrivals measured in scheduler ticks (deterministic on CPU)
+    arrival_tick = np.floor(np.cumsum(
+        rng.exponential(1.2, size=n_req))).astype(int)
+    reqs = []
+    tick = 0
+    while len(reqs) < n_req or sched.num_pending:
+        while len(reqs) < n_req and arrival_tick[len(reqs)] <= tick:
+            reqs.append(sched.submit(
+                prompts[len(reqs)],
+                sampling=SamplingParams(max_new_tokens=n_new)))
+        sched.step()
+        tick += 1
+        assert tick < 2000, "scheduler failed to converge"
+
+    assert sched.metrics.preemptions >= 1, \
+        "KV was sized to force preemption but none happened"
+    assert any(r.preemptions > 0 for r in reqs)
+    for r, w in zip(reqs, want):
+        assert r.state is RequestState.FINISHED, (r.uid, r.finish_reason)
+        assert r.generated == w, \
+            f"request {r.uid} (preempted {r.preemptions}x) diverged"
+    # all KV released
+    sm = eng.state_manager
+    assert sm.n_tracked_sequences == 0
+    assert sm.free_blocks == sm.allocator.num_blocks - 1
+
+
+def test_history_outgrowing_pool_truncates_not_livelocks(params):
+    """A request whose history outgrows the ENTIRE KV pool must finish
+    truncated (keeping its tokens), not spin in an infinite
+    preempt -> recompute -> preempt cycle: 6 usable blocks hold 48
+    tokens, so a 44-token prompt can only ever emit 5 tokens even
+    though max_new_tokens asks for 12."""
+    rng = np.random.default_rng(12)
+    prompt = rng.integers(0, CFG.vocab_size, size=(44,)).tolist()
+    want = _greedy_reference(params, [prompt], n_new=5)[0]
+
+    eng = _engine(params, token_budget=32, block_size=8, max_context=56,
+                  num_blocks=7)
+    sched = ContinuousBatchScheduler(eng)
+    r = sched.submit(prompt, sampling=SamplingParams(max_new_tokens=12))
+    sched.run_until_idle(max_ticks=100)
+    assert sched.num_pending == 0, "scheduler livelocked"
+    assert r.state is RequestState.FINISHED
+    assert r.finish_reason == "length"
+    assert r.generated == want               # truncated, still greedy-exact
+    sm = eng.state_manager
+    assert sm.n_tracked_sequences == 0
+    assert sm.free_blocks == sm.allocator.num_blocks - 1
+
+
+def test_stall_with_multiple_runners_preempts_not_fails(params):
+    """A joint mid-prefill KV deadlock is recoverable: _handle_stall must
+    preempt the newest runner (freeing its blocks) rather than FAIL a
+    request both of whose halves fit the pool individually."""
+    eng = _engine(params, token_budget=16, block_size=8, max_context=48,
+                  num_blocks=5)
+    sched = ContinuousBatchScheduler(eng)
+    rng = np.random.default_rng(13)
+    reqs = []
+    for uid in (1, 2):
+        r = Request(uid=uid,
+                    prompt=rng.integers(0, 256, size=(24,)).tolist())
+        eng.put([uid], [r.prompt[:16]])      # mid-prefill, 2 blocks held
+        r.transition(RequestState.PREFILL)
+        r.fed, r.admitted_at = 16, uid
+        sched._running[uid] = r
+        reqs.append(r)
+    assert eng.state_manager.free_blocks == 0    # jointly exhausted
+
+    sched._handle_stall()
+    a, b = reqs
+    assert b.state is RequestState.PREEMPTED and b.fed == 0   # newest
+    assert a.state is RequestState.PREFILL                    # untouched
+    assert eng.state_manager.get_sequence(2) is None
+    assert eng.state_manager.free_blocks == 2                 # blocks back
+    assert sched.metrics.preemptions == 1
+
+    # a SINGLE stalled holder can never fit — that one fails
+    del sched._preempted[:]
+    sched._handle_stall()
+    assert a.state is RequestState.FAILED
+    assert a.finish_reason == "kv_capacity"
+
+
+def test_preemption_victim_is_lowest_priority_then_newest(params):
+    sched = ContinuousBatchScheduler(_engine(params))
+    a = Request(uid=1, prompt=[1], priority=5)
+    b = Request(uid=2, prompt=[1], priority=0)
+    c = Request(uid=3, prompt=[1], priority=0)
+    for i, r in enumerate((a, b, c)):
+        r.state = RequestState.DECODE
+        r.admitted_at = i
+        sched._running[r.uid] = r
+    assert sched._pick_victim() is c      # lowest priority, newest
+    del sched._running[3]
+    assert sched._pick_victim() is b
+    del sched._running[2]
+    assert sched._pick_victim() is a
+
+
+# --------------------------------------------------------------------- #
+# Termination: stop tokens and max_new_tokens
+# --------------------------------------------------------------------- #
+def test_stop_token_termination(params):
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, CFG.vocab_size, size=(6,)).tolist()
+    ref = _greedy_reference(params, [prompt], n_new=8)[0]
+    stop = ref[3]
+    cut = ref.index(stop) + 1   # first occurrence ends the stream
+
+    sched = ContinuousBatchScheduler(_engine(params))
+    r = sched.submit(prompt, sampling=SamplingParams(
+        max_new_tokens=8, stop_token_ids=(stop,)))
+    sched.run_until_idle()
+    assert r.state is RequestState.FINISHED
+    assert r.finish_reason == "stop"
+    assert r.generated == ref[:cut]        # stop token included
+
+    # eos_token_id takes the same path
+    sched2 = ContinuousBatchScheduler(_engine(params))
+    r2 = sched2.submit(prompt, sampling=SamplingParams(
+        max_new_tokens=8, eos_token_id=stop))
+    sched2.run_until_idle()
+    assert r2.finish_reason == "stop" and r2.generated == ref[:cut]
+
+
+def test_max_new_tokens_termination(params):
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, CFG.vocab_size, size=(4,)).tolist()
+    sched = ContinuousBatchScheduler(_engine(params))
+    r = sched.submit(prompt, sampling=SamplingParams(max_new_tokens=3))
+    sched.run_until_idle()
+    assert r.finish_reason == "length" and len(r.generated) == 3
+
+
+# --------------------------------------------------------------------- #
+# Engine preemption primitives: flush_to_host / resume
+# --------------------------------------------------------------------- #
+def test_engine_flush_to_host_resume_roundtrip(params):
+    rng = np.random.default_rng(10)
+    prompt = rng.integers(0, CFG.vocab_size, size=(6,)).tolist()
+    want = _greedy_reference(params, [prompt], n_new=6)[0]
+
+    eng = _engine(params)
+    free0 = eng.state_manager.free_blocks
+    logits = eng.put([1], [prompt])
+    toks = [int(np.argmax(logits[1]))]
+    for _ in range(2):
+        logits = eng.put([1], [[toks[-1]]])
+        toks.append(int(np.argmax(logits[1])))
+
+    snap = eng.flush_to_host([1])
+    assert snap[1]["seen_tokens"] == len(prompt) + 2
+    assert eng.state_manager.free_blocks == free0   # blocks released
+    assert eng.state_manager.get_sequence(1) is None
+
+    # recompute-resume: re-prefill prompt + generated, continue greedy
+    logits = eng.resume(1, prompt + toks)
+    toks.append(int(np.argmax(logits[1])))
+    for _ in range(2):
+        logits = eng.put([1], [[toks[-1]]])
+        toks.append(int(np.argmax(logits[1])))
+    eng.flush([1])
+    assert toks == want
+
+
+def test_engine_flush_to_host_errors(params):
+    eng = _engine(params)
+    with pytest.raises(ValueError, match="unknown sequence"):
+        eng.flush_to_host([99])
+    eng.put([1], [[1, 2, 3]])
+    with pytest.raises(RuntimeError, match="still live"):
+        eng.resume(1, [1, 2, 3, 4])
+    eng.flush([1])
+
+
+# --------------------------------------------------------------------- #
+# Allocator hardening (O(1) double-free checks, order preserved)
+# --------------------------------------------------------------------- #
+def test_allocator_exhaustion_and_errors():
+    a = BlockedAllocator(8)
+    got = a.allocate(7)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        a.allocate(1)
+    a.free(got)
+    with pytest.raises(ValueError, match="trash"):
+        a.free([0])
+    with pytest.raises(ValueError, match="invalid block id"):
+        a.free([8])
+    with pytest.raises(ValueError, match="invalid block id"):
+        a.free([-1])
+
+
+def test_allocator_double_free_detected():
+    a = BlockedAllocator(8)
+    got = a.allocate(3)
+    a.free(got[:1])
+    with pytest.raises(ValueError, match="double free"):
+        a.free(got[:1])
+    with pytest.raises(ValueError, match="double free"):
+        a.free([got[1], got[1]])      # duplicate within one call
+    # a failed free() must not have corrupted state
+    a.free(got[1:])
+    assert a.free_blocks == 7
+
+
+def test_allocator_list_set_stay_consistent():
+    a = BlockedAllocator(16)
+    order0 = list(a._free)
+    x = a.allocate(5)
+    y = a.allocate(3)
+    a.free(x)
+    a.free(y)
+    assert sorted(a._free) == sorted(order0)
+    assert a._free_set == set(a._free)
+    assert len(a._free) == len(a._free_set)      # no duplicates
+    # allocation order follows the list, not the set
+    assert a.allocate(8) == (order0[8:] + x + y)[:8]
+
+
+# --------------------------------------------------------------------- #
+# Metrics + monitor plumbing (wall-clock x-axis)
+# --------------------------------------------------------------------- #
+def _csv_monitor(tmp_path):
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+    off = types.SimpleNamespace(enabled=False)
+    cfg = types.SimpleNamespace(
+        tensorboard=off, wandb=off,
+        csv_monitor=types.SimpleNamespace(enabled=True,
+                                          output_path=str(tmp_path),
+                                          job_name="serve"))
+    return MonitorMaster(cfg)
+
+
+def test_serving_metrics_export_wallclock_csv(params, tmp_path):
+    import csv
+
+    mon = _csv_monitor(tmp_path)
+    sched = ContinuousBatchScheduler(_engine(params), monitor=mon)
+    rng = np.random.default_rng(11)
+    for _ in range(2):
+        sched.submit(rng.integers(0, 256, size=(5,)).tolist(),
+                     sampling=SamplingParams(max_new_tokens=3))
+    sched.run_until_idle()
+
+    snap = sched.metrics.snapshot()
+    assert snap["finished"] == 2 and snap["total_tokens"] == 6
+    assert snap["p50_ttft_s"] > 0 and snap["p95_ttft_s"] >= snap["p50_ttft_s"]
+    assert snap["goodput_tokens_per_s"] > 0
+
+    f = tmp_path / "serve" / "serving_finished.csv"
+    assert f.exists(), list((tmp_path / "serve").iterdir())
+    rows = list(csv.reader(f.open()))
+    assert rows[0] == ["step", "serving/finished"]
+    # x is a wall-clock float (time.time()), not a fabricated int step
+    x = float(rows[-1][0])
+    assert x > 1e9 and not float(x).is_integer()
+    assert float(rows[-1][1]) == 2.0
+
+
+def test_monitor_int_steps_unchanged(tmp_path):
+    import csv
+
+    mon = _csv_monitor(tmp_path)
+    mon.write_events([("Train/lr", 0.1, 7)])
+    rows = list(csv.reader((tmp_path / "serve" / "Train_lr.csv").open()))
+    assert rows[1] == ["7", "0.1"]
+
+
+# --------------------------------------------------------------------- #
+# The tier-1 smoke (tools/serving_smoke.py)
+# --------------------------------------------------------------------- #
+def test_serving_smoke_tool():
+    path = pathlib.Path(__file__).resolve().parents[2] / "tools" / \
+        "serving_smoke.py"
+    spec = importlib.util.spec_from_file_location("serving_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    snap = mod.run_smoke()
+    assert snap["finished"] == 8 and snap["preemptions"] >= 1
