@@ -7,6 +7,7 @@ Directory layout::
 
     <save_dir>/<tag>/zero_pp_rank_{p}_mp_rank_00_states.npz  # per-process
     <save_dir>/<tag>/client_state.json
+    <save_dir>/<tag>/manifest.json                           # sizes + CRC32s
     <save_dir>/latest                                        # tag pointer
 
 Scalable by construction: each process writes only its addressable shards
@@ -17,19 +18,32 @@ coordinates so a checkpoint saved under one topology loads under ANY other
 Nebula analog, runtime/checkpoint_engine/nebula_checkpoint_engine.py:20) can
 swap in; ``commit`` is the durability barrier before the ``latest`` tag is
 published.
+
+Fault tolerance (:mod:`deepspeed_tpu.resilience`): shards stream to
+``<tag>.tmp/`` and each file's size + CRC32 is recorded in a per-tag
+``manifest.json``; only after every process's writes are durable is the
+staging dir renamed into place and ``latest`` republished via write-temp +
+``os.replace`` + fsync.  A crash at ANY instant leaves ``latest`` pointing
+at a fully verified tag.  ``load_engine_state`` validates the manifest and
+walks back to the newest verified tag instead of loading corrupt state or
+crashing when an older good tag exists.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
+import re
+import shutil
 import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from deepspeed_tpu.checkpoint import sharded
+from deepspeed_tpu.resilience import manifest as rz_manifest
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.tensors import flat_dict_to_tree
 
@@ -44,51 +58,82 @@ class CheckpointEngine:
         log_dist(f"Saving checkpoint tag={tag}", ranks=[0])
 
     def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
-        np.savez(path, **state_dict)
+        sharded.write_npz(path, state_dict)
 
     def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
-        with np.load(path, allow_pickle=False) as data:
+        with np.load(sharded.npz_path(path), allow_pickle=False) as data:
             return {k: data[k] for k in data.files}
 
     def commit(self, tag: str) -> bool:
         return True
 
 
+class _PendingWrite:
+    __slots__ = ("path", "done", "error")
+
+    def __init__(self, path: str):
+        self.path = path
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
 class AsyncCheckpointEngine(CheckpointEngine):
-    """Background-thread writer (reference: the async Nebula engine,
+    """Bounded background writer pool (reference: the async Nebula engine,
     runtime/checkpoint_engine/nebula_checkpoint_engine.py:20).
 
-    ``save`` returns as soon as the host copy is handed to the writer thread;
-    ``commit`` blocks until every pending write is durable, so the ``latest``
-    tag is never published ahead of the data."""
+    ``save`` returns as soon as the host copy is queued — at most
+    ``max_workers`` writer threads ever exist, so a many-shard save
+    cannot fork an unbounded thread herd; ``commit`` blocks until every
+    pending write is durable (and surfaces the first error), so the
+    ``latest`` tag is never published ahead of the data.  The workers
+    are DAEMON threads fed from a queue — ``commit()`` is the only place
+    that ever waits on them, so a write wedged on a dead mount cannot
+    block interpreter exit the way an atexit-joined executor would."""
 
-    def __init__(self, config_params=None):
+    def __init__(self, config_params=None, max_workers: int = 2):
         super().__init__(config_params)
-        self._pending: list = []
-        self._errors: list = []
+        if max_workers < 1:
+            raise ValueError("max_workers must be >= 1")
+        self._max_workers = max_workers
+        self._queue: "queue.Queue[Tuple[_PendingWrite, Dict]]" = queue.Queue()
+        self._workers: List[threading.Thread] = []
+        self._pending: List[_PendingWrite] = []
         self._lock = threading.Lock()
 
-    def _write(self, path: str, state_dict: Dict[str, np.ndarray]) -> None:
-        try:
-            np.savez(path, **state_dict)
-        except BaseException as e:  # surfaced by commit()
-            with self._lock:
-                self._errors.append((path, e))
+    def _worker(self) -> None:
+        while True:
+            pw, payload = self._queue.get()
+            try:
+                sharded.write_npz(pw.path, payload)
+            except BaseException as e:  # noqa: BLE001 — surfaced by commit
+                pw.error = e
+            finally:
+                pw.done.set()
+
+    def _ensure_workers(self) -> None:
+        with self._lock:
+            while len(self._workers) < self._max_workers:
+                t = threading.Thread(
+                    target=self._worker, daemon=True,
+                    name=f"ckpt-writer-{len(self._workers)}")
+                t.start()
+                self._workers.append(t)
 
     def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
-        t = threading.Thread(target=self._write, args=(path, state_dict),
-                             daemon=True)
-        t.start()
+        self._ensure_workers()
+        pw = _PendingWrite(path)
         with self._lock:
-            self._pending.append(t)
+            self._pending.append(pw)
+        self._queue.put((pw, state_dict))
 
     def commit(self, tag: str) -> bool:
         with self._lock:
             pending, self._pending = self._pending, []
-        for t in pending:
-            t.join()
-        with self._lock:
-            errors, self._errors = self._errors, []
+        errors = []
+        for pw in pending:
+            pw.done.wait()
+            if pw.error is not None:
+                errors.append((pw.path, pw.error))
         if errors:
             path, exc = errors[0]
             raise RuntimeError(
@@ -105,10 +150,31 @@ def save_engine_state(engine, save_dir: str, tag: str,
                       client_state: Dict[str, Any],
                       save_latest: bool = True,
                       checkpoint_engine: Optional[CheckpointEngine] = None) -> str:
+    """Atomic checkpoint commit: stage -> checksum -> rename -> publish.
+
+    Every process streams its shards into ``<tag>.tmp/`` and records a
+    size+CRC32 sidecar for each file it wrote; after ``ce.commit()`` plus
+    a barrier proves everything durable, process 0 merges the sidecars
+    into ``manifest.json``, renames the staging dir to ``<tag>/`` (the
+    commit point), and atomically republishes ``latest``.  A crash before
+    the rename leaves only a ``.tmp`` dir the next save (or retention GC)
+    sweeps; a crash after it at worst leaves ``latest`` one tag behind —
+    never pointing at a torn checkpoint.
+    """
     ce = checkpoint_engine or getattr(engine, "checkpoint_engine", None) \
         or CheckpointEngine()
-    path = os.path.join(save_dir, str(tag))
-    os.makedirs(path, exist_ok=True)  # every process may race; exist_ok
+    final_path = os.path.join(save_dir, str(tag))
+    tmp_path = final_path + rz_manifest.TMP_SUFFIX
+
+    from deepspeed_tpu import comm as dist
+
+    if _is_writer() and os.path.isdir(tmp_path):
+        logger.warning(f"removing stale staging dir {tmp_path} "
+                       "(crashed earlier save)")
+        shutil.rmtree(tmp_path)
+    # no process stages files until the stale dir is gone
+    dist.barrier()
+    os.makedirs(tmp_path, exist_ok=True)  # every process may race; exist_ok
     ce.create(tag)
 
     state = engine.state
@@ -117,49 +183,200 @@ def save_engine_state(engine, save_dir: str, tag: str,
                             "hysteresis") if name in state}
     tree = {"master": state["master"], "opt": state["opt"],
             "acc_grads": state["acc_grads"]}
-    sharded.save_process_shards(tree, path, scalars=scalars,
-                                checkpoint_engine=ce)
+    local_files = [sharded.save_process_shards(
+        tree, tmp_path, scalars=scalars, checkpoint_engine=ce)]
     if _is_writer():
-        with open(os.path.join(path, "client_state.json"), "w") as f:
+        cs_path = os.path.join(tmp_path, "client_state.json")
+        with open(cs_path, "w") as f:
             json.dump(client_state, f, indent=2, default=str)
+        local_files.append(cs_path)
 
-    from deepspeed_tpu import comm as dist
-
-    # drain this process's writes, THEN barrier: every process's shards are
-    # durable before the tag is published (async engine included)
+    # drain this process's writes FIRST (async engine included) so the
+    # bytes being checksummed are the bytes on disk
     ce.commit(tag)
+    rz_manifest.write_sidecars(tmp_path, local_files)
+    # every process durable + checksummed before the tag is committed
     dist.barrier()
-    if save_latest and _is_writer():
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(str(tag))
+    if _is_writer():
+        step = scalars.get("step")
+        rz_manifest.finalize_tag(
+            tmp_path, final_path, str(tag),
+            step=None if step is None else int(step))
+        if save_latest:
+            rz_manifest.publish_latest(save_dir, str(tag))
     # no process returns until the tag is published, so an immediate
     # collective load(tag=None) sees the same checkpoint everywhere
     dist.barrier()
-    return path
+    return final_path
 
 
 def load_engine_state(engine, load_dir: str, tag: Optional[str] = None,
                       load_optimizer_states: bool = True,
-                      checkpoint_engine: Optional[CheckpointEngine] = None
-                      ) -> Tuple[Optional[str], Dict[str, Any]]:
-    ce = checkpoint_engine or CheckpointEngine()
-    if tag is None:
-        latest = os.path.join(load_dir, "latest")
-        if not os.path.exists(latest):
-            logger.warning(f"no 'latest' file in {load_dir}; nothing loaded")
-            return None, {}
-        with open(latest) as f:
-            tag = f.read().strip()
-    path = os.path.join(load_dir, str(tag))
-    if not os.path.isdir(path):
-        logger.warning(f"checkpoint dir {path} not found")
-        return None, {}
+                      checkpoint_engine: Optional[CheckpointEngine] = None,
+                      verify: str = "full", fallback: bool = True,
+                      metrics=None) -> Tuple[Optional[str], Dict[str, Any]]:
+    """Verified load with fallback.
 
+    ``verify``: ``"full"`` (size + CRC32 against the manifest), ``"size"``
+    (cheap, catches truncation only), or ``"off"``.  When the requested /
+    ``latest`` tag fails verification (or its directory is gone — a stale
+    ``latest``), ``fallback=True`` walks back to the newest verified tag
+    at or below the requested step instead of crashing, logging exactly
+    what was wrong with each rejected tag.  A tag without a manifest loads
+    (unverified, with a warning) only when NO manifested tag exists — the
+    pure pre-manifest-checkpoint case.
+
+    ``metrics``: an optional
+    :class:`~deepspeed_tpu.resilience.metrics.ResilienceMetrics` that
+    receives ``record_verify_failure`` / ``record_fallback`` calls.
+    """
+    if verify not in ("full", "size", "off"):
+        raise ValueError(f"verify must be 'full', 'size' or 'off', "
+                         f"got {verify!r}")
+    ce = checkpoint_engine or CheckpointEngine()
     if engine.state is None:
         raise RuntimeError(
             "engine state must be initialised (run a forward or "
             "initialize_parameters) before load_checkpoint")
 
+    latest = rz_manifest.read_latest(load_dir)
+    infos = rz_manifest.candidate_tags(load_dir)
+    by_tag = {t.tag: t for t in infos}
+
+    def tag_step(name: str) -> Optional[int]:
+        info = by_tag.get(name)
+        if info is not None and info.step is not None:
+            return info.step
+        m = re.search(r"(\d+)$", name)  # "global_step123" convention
+        return int(m.group(1)) if m else None
+
+    candidates: List[str] = []
+    if tag is not None:
+        requested = str(tag)
+        candidates.append(requested)
+        if fallback:
+            # never "fall back" FORWARD past an explicitly asked-for step;
+            # when the request's step cannot be determined (dir gone AND
+            # unparseable name) no candidate can be ordered against it —
+            # refuse to guess rather than silently load a future step
+            req_step = tag_step(requested)
+            if req_step is not None:
+                for t in infos:
+                    t_step = tag_step(t.tag)
+                    if t.tag == requested or t_step is None \
+                            or t_step > req_step:
+                        continue
+                    candidates.append(t.tag)
+    else:
+        if latest is not None:
+            candidates.append(latest)
+        if fallback:
+            candidates.extend(t.tag for t in infos if t.tag != latest)
+        if not candidates:
+            logger.warning(f"no 'latest' file or checkpoint tags in "
+                           f"{load_dir}; nothing loaded")
+            return None, {}
+
+    any_manifested = any(t.has_manifest for t in infos)
+    requested = str(tag) if tag is not None else None
+    primary = candidates[0]
+
+    def verified_candidates():
+        """Yield (tag, path) for each candidate that passes verification,
+        in fallback order, logging exactly why each rejected tag failed."""
+        for t in candidates:
+            path = os.path.join(load_dir, t)
+            if not os.path.isdir(path):
+                logger.warning(
+                    f"checkpoint tag {t!r}: directory {path} missing"
+                    + (" — STALE 'latest' pointer" if t == latest else ""))
+                if metrics is not None:
+                    metrics.record_verify_failure(t, ["directory missing"])
+                continue
+            if verify != "off":
+                info = by_tag.get(t)
+                if info is not None and not info.has_manifest \
+                        and (not any_manifested or t == requested):
+                    # a tag COMMITTED by the atomic protocol always has a
+                    # manifest (the rename happens after the merge), so a
+                    # missing one means a pre-manifest checkpoint: honor
+                    # an explicit request for it rather than refusing
+                    logger.warning(
+                        f"checkpoint tag {t!r} has no manifest.json "
+                        "(pre-manifest checkpoint) — loading UNVERIFIED")
+                else:
+                    ok, problems = rz_manifest.verify_tag(path, mode=verify)
+                    if not ok:
+                        logger.warning(
+                            f"checkpoint tag {t!r} failed verification "
+                            f"({verify}): " + "; ".join(problems))
+                        if metrics is not None:
+                            metrics.record_verify_failure(t, problems)
+                        continue
+            yield t, path
+
+    if jax.process_count() > 1:
+        # multi-process consensus: process 0 alone walks/verifies (ONE
+        # full-read CRC pass over the shared FS, not one per process) and
+        # broadcasts its choice — every process loads the SAME tag or
+        # none; divergent per-host fallback would silently fork the run
+        chosen = None
+        if _is_writer():
+            chosen = next((t for t, _ in verified_candidates()), None)
+        chosen = _broadcast_tag(chosen)
+        if chosen is None:
+            log_dist(f"no loadable checkpoint in {load_dir} "
+                     f"(tried {candidates})", ranks=[0])
+            return None, {}
+        if chosen != primary and _is_writer():
+            logger.warning(
+                f"checkpoint fallback: wanted {primary!r}, loading the "
+                f"newest verified tag {chosen!r}")
+            if metrics is not None:
+                metrics.record_fallback(primary, chosen)
+        # after consensus a per-host load failure must be LOUD (raise),
+        # not a local fallback that diverges from the other hosts
+        return _load_tag(engine, os.path.join(load_dir, chosen), ce,
+                         load_optimizer_states)
+
+    for t, path in verified_candidates():
+        try:
+            result = _load_tag(engine, path, ce, load_optimizer_states)
+        except Exception as e:  # noqa: BLE001 — fall back to an older tag
+            logger.warning(f"loading checkpoint tag {t!r} failed: {e}")
+            if metrics is not None:
+                metrics.record_verify_failure(t, [str(e)])
+            continue
+        if t != primary:
+            logger.warning(
+                f"checkpoint fallback: wanted {primary!r}, loaded the "
+                f"newest verified tag {t!r}")
+            if metrics is not None:
+                metrics.record_fallback(primary, t)
+        return result
+    logger.warning(f"no loadable checkpoint in {load_dir} "
+                   f"(tried {candidates})")
+    return None, {}
+
+
+def _broadcast_tag(tag: Optional[str], max_len: int = 512) -> Optional[str]:
+    """Broadcast process 0's chosen tag to every process (fixed-width
+    uint8 buffer over the device mesh)."""
+    from jax.experimental import multihost_utils
+
+    data = (tag or "").encode()
+    if len(data) > max_len:
+        raise ValueError(f"checkpoint tag too long to broadcast: {tag!r}")
+    buf = np.zeros(max_len, np.uint8)
+    buf[:len(data)] = np.frombuffer(data, np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return out.tobytes().rstrip(b"\x00").decode() or None
+
+
+def _load_tag(engine, path: str, ce: CheckpointEngine,
+              load_optimizer_states: bool) -> Tuple[str, Dict[str, Any]]:
+    """Load one verified tag directory into the engine (raises on any
+    problem so the caller can fall back)."""
     sh = engine._state_shardings()
     new_state = dict(engine.state)
     try:
@@ -201,8 +418,6 @@ def load_engine_state(engine, load_dir: str, tag: Optional[str] = None,
     else:
         new_state = _load_legacy_consolidated(
             engine, path, ce, sh, new_state, load_optimizer_states)
-        if new_state is None:
-            return None, {}
 
     new_state["params"] = jax.jit(
         lambda m: jax.tree.map(lambda x: x.astype(engine.compute_dtype), m),
@@ -223,8 +438,7 @@ def _load_legacy_consolidated(engine, path, ce, sh, new_state,
     """Round-1 layout: consolidated mp_rank_00_model_states.npz."""
     model_file = os.path.join(path, "mp_rank_00_model_states.npz")
     if not os.path.exists(model_file):
-        logger.warning(f"checkpoint {model_file} not found")
-        return None
+        raise FileNotFoundError(f"checkpoint {model_file} not found")
     model_flat = ce.load(model_file)
     master = flat_dict_to_tree(model_flat, engine.state["master"])
     new_state["master"] = jax.tree.map(

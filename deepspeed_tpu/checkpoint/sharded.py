@@ -29,9 +29,30 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.resilience import chaos
 from deepspeed_tpu.utils.tensors import tree_to_flat_dict
 
 SHARD_FILE = "zero_pp_rank_{proc}_mp_rank_00_states.npz"
+
+
+def npz_path(path: str) -> str:
+    """``np.savez`` silently appends ``.npz`` when the suffix is absent;
+    normalising BOTH save and load through this keeps the two sides
+    agreeing on the on-disk path."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def write_npz(path: str, payload: Dict[str, np.ndarray]) -> str:
+    """The one write primitive for checkpoint shards: explicit ``.npz``
+    suffix, fsync before returning, and the chaos fault points the
+    resilience tests drive.  Returns the actual on-disk path."""
+    path = npz_path(path)
+    chaos.fire("slow_io", path=path)
+    np.savez(path, **payload)
+    with open(path, "rb") as f:
+        os.fsync(f.fileno())
+    chaos.fire("crash_after_shard_write", path=path)
+    return path
 
 
 def _leaf_items(tree) -> Dict[str, Any]:
@@ -79,7 +100,7 @@ def save_process_shards(tree, dirpath: str, scalars: Optional[Dict] = None,
     if checkpoint_engine is not None:
         checkpoint_engine.save(payload, path)
     else:
-        np.savez(path, **payload)
+        write_npz(path, payload)
     return path
 
 

@@ -3,9 +3,10 @@ TensorBoard / WandB / CSV writers).
 
 An event's x value is either a training step (int) or a WALL-CLOCK
 timestamp (float seconds, e.g. ``time.time()``).  Serving-side series
-(``serving/*``) have no step counter — a float x lets them plot against
-real time instead of fabricating step numbers; each writer maps a float x
-onto its closest native notion of wall time.
+(``serving/*``) and resilience telemetry (``resilience/*`` — save latency,
+verify failures, resumes, rollbacks) have no step counter — a float x lets
+them plot against real time instead of fabricating step numbers; each
+writer maps a float x onto its closest native notion of wall time.
 """
 
 from __future__ import annotations

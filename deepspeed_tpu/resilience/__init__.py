@@ -1,0 +1,23 @@
+"""Fault-tolerant checkpointing & auto-resume.
+
+The pieces (used together by the checkpoint path and
+:class:`ResilientTrainLoop`):
+
+* :mod:`.manifest` — checksummed per-tag manifests, the atomic
+  stage/rename/publish commit protocol, and tag verification/fallback
+  enumeration.
+* :mod:`.chaos` — deterministic named fault points the tests and
+  ``tools/chaos_smoke.py`` drive, so the crash-recovery guarantees are
+  testable rather than asserted.
+* :mod:`.loop` — :class:`ResilientTrainLoop`: periodic commits,
+  ``auto_resume()``, retention GC, and the NaN/loss-spike sentinel.
+* :mod:`.metrics` — ``resilience/*`` monitor series.
+"""
+
+from deepspeed_tpu.resilience import chaos, manifest
+from deepspeed_tpu.resilience.chaos import ChaosInjectedError
+from deepspeed_tpu.resilience.loop import ResilientTrainLoop, apply_retention
+from deepspeed_tpu.resilience.metrics import ResilienceMetrics
+
+__all__ = ["ChaosInjectedError", "ResilienceMetrics", "ResilientTrainLoop",
+           "apply_retention", "chaos", "manifest"]

@@ -1,0 +1,182 @@
+"""Deterministic fault-injection harness for the checkpoint path.
+
+Production code is instrumented with *named fault points* — ``fire(point)``
+calls that are free no-ops until a fault is **armed** at that point.  Tests
+and the chaos smoke tool arm faults to prove the crash-recovery invariants
+(a kill at any point during save leaves ``latest`` pointing at a fully
+verified tag; silent corruption is detected at load) instead of asserting
+them.
+
+Fault points (all live in :mod:`deepspeed_tpu.checkpoint.engine`):
+
+``slow_io``
+    before a shard file's bytes are written (default action: ``sleep``).
+``crash_after_shard_write``
+    after a shard file is written and fsynced (default: ``crash``).
+``corrupt_shard_bytes``
+    after a shard's checksum is recorded in its sidecar — firing the
+    default ``corrupt`` action here models silent bit-rot *after* a good
+    write, exactly what the manifest CRC exists to catch.
+``fail_latest_publish``
+    after the tag directory is renamed into place but before the
+    ``latest`` pointer is republished (default: ``crash``).
+
+Actions: ``crash`` (``os._exit``, for subprocess kill tests), ``raise``
+(:class:`ChaosInjectedError`, for in-process tests), ``corrupt`` (flip one
+byte of the file at the fault point's ``path``), ``sleep``.
+
+Arming: :func:`arm` / :func:`disarm` / the :func:`inject` context manager,
+or the ``DS_CHAOS`` environment variable for subprocesses, e.g.::
+
+    DS_CHAOS="crash_after_shard_write:after=1,exit_code=43"
+
+``after=N`` skips the first N hits of the point (fire on hit N+1);
+``count=M`` fires at most M times (default 1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import time
+from typing import Dict, Iterator, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+#: Every legal fault point name -> its default action.
+FAULT_POINTS: Dict[str, str] = {
+    "slow_io": "sleep",
+    "crash_after_shard_write": "crash",
+    "corrupt_shard_bytes": "corrupt",
+    "fail_latest_publish": "crash",
+}
+
+ENV_VAR = "DS_CHAOS"
+
+
+class ChaosInjectedError(RuntimeError):
+    """Raised by a fault armed with action='raise'."""
+
+
+@dataclasses.dataclass
+class Fault:
+    point: str
+    action: str
+    after: int = 0          # skip the first ``after`` hits
+    count: int = 1          # fire at most ``count`` times (0 = unlimited)
+    sleep_s: float = 0.05   # action='sleep'
+    exit_code: int = 43     # action='crash'
+    hits: int = 0
+    fires: int = 0
+
+
+_armed: Dict[str, Fault] = {}
+_env_loaded = False
+
+
+def arm(point: str, action: Optional[str] = None, **kwargs) -> Fault:
+    """Arm ``point`` with ``action`` (default: the point's natural action)."""
+    if point not in FAULT_POINTS:
+        raise ValueError(f"unknown fault point {point!r}; "
+                         f"known: {sorted(FAULT_POINTS)}")
+    action = action or FAULT_POINTS[point]
+    if action not in ("crash", "raise", "corrupt", "sleep"):
+        raise ValueError(f"unknown chaos action {action!r}")
+    fault = Fault(point=point, action=action, **kwargs)
+    _armed[point] = fault
+    return fault
+
+
+def disarm(point: Optional[str] = None) -> None:
+    """Disarm one point, or everything (``point=None``)."""
+    if point is None:
+        _armed.clear()
+    else:
+        _armed.pop(point, None)
+
+
+def armed(point: str) -> Optional[Fault]:
+    return _armed.get(point)
+
+
+@contextlib.contextmanager
+def inject(point: str, action: Optional[str] = None,
+           **kwargs) -> Iterator[Fault]:
+    """``with chaos.inject("slow_io", action="raise"): ...`` — armed only
+    inside the block."""
+    fault = arm(point, action, **kwargs)
+    try:
+        yield fault
+    finally:
+        disarm(point)
+
+
+def _load_env_once() -> None:
+    """Arm faults from ``DS_CHAOS`` (subprocess-facing; parsed lazily at
+    the first fault-point hit so importing this module stays free)."""
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    spec = os.environ.get(ENV_VAR, "").strip()
+    if not spec:
+        return
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, opt_str = part.partition(":")
+        opts: Dict[str, object] = {}
+        for kv in filter(None, (s.strip() for s in opt_str.split(","))):
+            k, _, v = kv.partition("=")
+            if k == "action":
+                opts[k] = v
+            elif k == "sleep_s":
+                opts[k] = float(v)
+            else:
+                opts[k] = int(v)
+        action = opts.pop("action", None)
+        arm(name.strip(), action, **opts)  # type: ignore[arg-type]
+        logger.warning(f"chaos: armed from {ENV_VAR}: {part}")
+
+
+def _flip_byte(path: str) -> None:
+    """Flip one byte in the middle of ``path`` (deterministic offset)."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    offset = size // 2
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        byte = f.read(1)
+        f.seek(offset)
+        f.write(bytes([byte[0] ^ 0xFF]))
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def fire(point: str, path: Optional[str] = None) -> None:
+    """The fault point itself: a no-op unless ``point`` is armed."""
+    _load_env_once()
+    fault = _armed.get(point)
+    if fault is None:
+        return
+    fault.hits += 1
+    if fault.hits <= fault.after:
+        return
+    if fault.count and fault.fires >= fault.count:
+        return
+    fault.fires += 1
+    logger.warning(f"chaos: firing {point} (action={fault.action}, "
+                   f"hit={fault.hits}, path={path})")
+    if fault.action == "sleep":
+        time.sleep(fault.sleep_s)
+    elif fault.action == "corrupt":
+        if path is not None and os.path.exists(path):
+            _flip_byte(path)
+    elif fault.action == "crash":
+        # simulate a hard kill: no cleanup handlers, no flushing
+        os._exit(fault.exit_code)
+    else:
+        raise ChaosInjectedError(f"chaos fault injected at {point!r}")

@@ -1,0 +1,82 @@
+"""Resilience telemetry: save latency, verify failures, resumes, rollbacks.
+
+Mirrors :class:`~deepspeed_tpu.serving.metrics.ServingMetrics`: the loop
+and the verified loader call ``record_*`` hooks; ``export()`` pushes
+``resilience/*`` scalars through the existing monitor fan-out with a
+wall-clock float x (the writers already accept float steps).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class ResilienceMetrics:
+    def __init__(self, monitor=None):
+        self.monitor = monitor
+        self.saves = 0
+        self.save_failures = 0
+        self.last_save_latency_s = 0.0
+        self.total_save_latency_s = 0.0
+        self.verify_failures = 0
+        self.fallbacks = 0
+        self.resumes = 0
+        self.rollbacks = 0
+        self.skipped_steps = 0
+        self.gc_deleted_tags = 0
+
+    # -- hooks ---------------------------------------------------------- #
+    def record_save(self, latency_s: float) -> None:
+        self.saves += 1
+        self.last_save_latency_s = float(latency_s)
+        self.total_save_latency_s += float(latency_s)
+
+    def record_save_failure(self) -> None:
+        self.save_failures += 1
+
+    def record_verify_failure(self, tag: str, problems: List[str]) -> None:
+        self.verify_failures += 1
+
+    def record_fallback(self, from_tag: str, to_tag: Optional[str]) -> None:
+        self.fallbacks += 1
+
+    def record_resume(self, tag: Optional[str], step: int) -> None:
+        self.resumes += 1
+
+    def record_rollback(self, at_step: int) -> None:
+        self.rollbacks += 1
+
+    def record_skip(self, step: int) -> None:
+        self.skipped_steps += 1
+
+    def record_gc(self, deleted: int) -> None:
+        self.gc_deleted_tags += deleted
+
+    # -- aggregates ----------------------------------------------------- #
+    def mean_save_latency_s(self) -> float:
+        return self.total_save_latency_s / max(self.saves, 1)
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "saves": float(self.saves),
+            "save_failures": float(self.save_failures),
+            "save_latency_s": self.last_save_latency_s,
+            "mean_save_latency_s": self.mean_save_latency_s(),
+            "verify_failures": float(self.verify_failures),
+            "fallbacks": float(self.fallbacks),
+            "resumes": float(self.resumes),
+            "rollbacks": float(self.rollbacks),
+            "skipped_steps": float(self.skipped_steps),
+            "gc_deleted_tags": float(self.gc_deleted_tags),
+        }
+
+    def export(self, monitor=None,
+               now: Optional[float] = None) -> List[Tuple[str, float, float]]:
+        monitor = monitor if monitor is not None else self.monitor
+        wall = time.time() if now is None else now
+        events = [(f"resilience/{k}", v, wall)
+                  for k, v in self.snapshot().items()]
+        if monitor is not None and getattr(monitor, "enabled", False):
+            monitor.write_events(events)
+        return events

@@ -1255,12 +1255,17 @@ class DeepSpeedEngine:
                         load_module_strict: bool = True,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True,
-                        load_module_only: bool = False):
+                        load_module_only: bool = False,
+                        verify: str = "full", fallback: bool = True,
+                        metrics=None):
         from deepspeed_tpu.checkpoint.engine import load_engine_state
 
         path, client_state = load_engine_state(
             self, load_dir, tag,
-            load_optimizer_states=load_optimizer_states and not load_module_only)
+            load_optimizer_states=load_optimizer_states and not load_module_only,
+            verify=verify, fallback=fallback, metrics=metrics)
+        if path is None:
+            return None, {}
         # the loaded state supersedes any update applied by a fused
         # init-forward; drop its pending bookkeeping
         self._pending_step = None

@@ -18,7 +18,9 @@ from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import (Request, RequestState,
                                            SamplingParams)
 from deepspeed_tpu.serving.sampler import sample_batch, sample_one
-from deepspeed_tpu.serving.scheduler import ContinuousBatchScheduler
+from deepspeed_tpu.serving.scheduler import (ContinuousBatchScheduler,
+                                             QueueFullError)
 
-__all__ = ["ContinuousBatchScheduler", "Request", "RequestState",
-           "SamplingParams", "ServingMetrics", "sample_batch", "sample_one"]
+__all__ = ["ContinuousBatchScheduler", "QueueFullError", "Request",
+           "RequestState", "SamplingParams", "ServingMetrics",
+           "sample_batch", "sample_one"]

@@ -41,8 +41,10 @@ class ServingMetrics:
         self.window_s = window_s
         self.started = time.monotonic()
         self.submitted = 0
+        self.rejected = 0                # bounded-queue admission rejects
         self.finished = 0
         self.failed = 0
+        self.deadline_exceeded = 0       # failed with reason "deadline"
         self.preemptions = 0
         self.preempted_requests = 0      # ever preempted (incl. in-flight)
         self._terminal_preempted = 0     # preempted AND reached a terminal state
@@ -59,6 +61,9 @@ class ServingMetrics:
     def record_submit(self, req: Request) -> None:
         self.submitted += 1
 
+    def record_reject(self, req: Request) -> None:
+        self.rejected += 1
+
     def record_preemption(self, req: Request) -> None:
         self.preemptions += 1
         if req.preemptions == 1:
@@ -71,6 +76,8 @@ class ServingMetrics:
             self._terminal_preempted += 1
         if req.state.value == "failed":
             self.failed += 1
+            if req.finish_reason == "deadline":
+                self.deadline_exceeded += 1
             return
         self.finished += 1
         self.total_tokens += len(req.generated)
@@ -114,8 +121,10 @@ class ServingMetrics:
     def snapshot(self) -> Dict[str, float]:
         out: Dict[str, float] = {
             "submitted": float(self.submitted),
+            "rejected": float(self.rejected),
             "finished": float(self.finished),
             "failed": float(self.failed),
+            "deadline_exceeded": float(self.deadline_exceeded),
             "preemptions": float(self.preemptions),
             "preempted_requests": float(self.preempted_requests),
             "preemption_rate": self.preemption_rate(),

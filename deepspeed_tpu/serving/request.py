@@ -94,6 +94,9 @@ class Request:
     prompt: List[int]
     sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
     priority: int = 0                    # higher = preempted later
+    #: wall-clock budget from arrival; past it the scheduler fails the
+    #: request with reason "deadline" at the next tick (None = no SLO)
+    deadline_s: Optional[float] = None
     arrival_time: float = dataclasses.field(default_factory=time.monotonic)
     #: called as ``on_token(request, token)`` for every emitted token
     #: (streaming hook).  A raising callback is disabled and logged, not
@@ -120,6 +123,13 @@ class Request:
     def __post_init__(self):
         if not self.prompt:
             raise ValueError(f"request {self.uid}: empty prompt")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(f"request {self.uid}: deadline_s must be > 0")
+
+    @property
+    def past_deadline(self) -> bool:
+        return (self.deadline_s is not None
+                and time.monotonic() - self.arrival_time > self.deadline_s)
 
     # ------------------------------------------------------------------ #
     @property

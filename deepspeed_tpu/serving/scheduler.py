@@ -40,13 +40,20 @@ from deepspeed_tpu.serving.sampler import sample_batch
 from deepspeed_tpu.utils.logging import logger
 
 
+class QueueFullError(RuntimeError):
+    """``submit()`` rejected: the admission queue is at ``max_queue``.
+    Back off and retry (or shed load) — the queue will not grow without
+    bound under overload."""
+
+
 class ContinuousBatchScheduler:
     """Owns the request lifecycle between user ``submit()`` calls and
     :class:`~deepspeed_tpu.inference.v2.engine_v2.InferenceEngineV2`."""
 
     def __init__(self, engine, monitor=None,
                  metrics: Optional[ServingMetrics] = None,
-                 export_every: int = 0):
+                 export_every: int = 0,
+                 max_queue: Optional[int] = None):
         self.engine = engine
         sm_cfg = engine.config.state_manager
         self.token_budget = sm_cfg.max_ragged_batch_size
@@ -57,6 +64,10 @@ class ContinuousBatchScheduler:
         #: export serving/* scalars through the monitor every N ticks
         #: (0 = only on run_until_idle/drain completion)
         self.export_every = export_every
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None for unbounded)")
+        #: bounded admission: submit() raises QueueFullError past this
+        self.max_queue = max_queue
         self._queued: List[Request] = []
         self._running: Dict[int, Request] = {}
         self._preempted: List[Request] = []
@@ -71,7 +82,8 @@ class ContinuousBatchScheduler:
     def submit(self, prompt: Optional[Sequence[int]] = None,
                sampling: Optional[SamplingParams] = None,
                priority: int = 0, uid: Optional[int] = None,
-               on_token=None, request: Optional[Request] = None) -> Request:
+               on_token=None, deadline_s: Optional[float] = None,
+               request: Optional[Request] = None) -> Request:
         """Enqueue one generation request; returns the tracked
         :class:`Request` (read its ``state``/``generated`` as it runs)."""
         if request is None:
@@ -87,12 +99,19 @@ class ContinuousBatchScheduler:
                 uid=uid,
                 prompt=[int(t) for t in prompt],
                 sampling=sampling or SamplingParams(),
-                priority=priority, on_token=on_token)
+                priority=priority, deadline_s=deadline_s,
+                on_token=on_token)
         if request.state is not RequestState.QUEUED:
             raise ValueError(f"submit: request {request.uid} already "
                              f"{request.state.value}")
         if self._is_tracked_uid(request.uid):
             raise ValueError(f"submit: uid {request.uid} already live")
+        if self.max_queue is not None and len(self._queued) >= self.max_queue:
+            self.metrics.record_reject(request)
+            raise QueueFullError(
+                f"submit: admission queue full ({len(self._queued)} waiting, "
+                f"max_queue={self.max_queue}) — request {request.uid} "
+                "rejected; retry after the queue drains")
         if len(request.prompt) + 1 > self.max_context:
             raise ValueError(
                 f"submit: prompt of {len(request.prompt)} tokens cannot fit "
@@ -134,6 +153,7 @@ class ContinuousBatchScheduler:
     def step(self) -> List[Tuple[Request, int]]:
         """Pack one engine forward and sample its logits.  Returns the
         ``(request, token)`` pairs emitted this tick."""
+        self._expire_deadlines()
         self._reap_unservable()
         uids: List[int] = []
         chunks: List[List[int]] = []
@@ -270,6 +290,16 @@ class ContinuousBatchScheduler:
         self._finished.append(req)
         self.metrics.record_finish(req)
         logger.warning(f"serving: request {req.uid} failed: {reason}")
+
+    def _expire_deadlines(self) -> None:
+        """Fail every non-terminal request past its ``deadline_s`` (reason
+        "deadline") — queued, running, or preempted alike.  Tokens already
+        generated stay on the request, but a blown SLO is a failure: the
+        client stopped waiting, so finishing the work is wasted compute."""
+        for req in [*self._queued, *self._running.values(),
+                    *self._preempted]:
+            if req.past_deadline:
+                self._fail(req, "deadline")
 
     def _reap_unservable(self) -> None:
         """Terminate requests whose token history has outgrown the ENTIRE

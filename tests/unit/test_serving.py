@@ -10,6 +10,7 @@ tests — correctness bar is token-for-token parity with an unscheduled
 
 import importlib.util
 import pathlib
+import time
 import types
 
 import jax
@@ -22,8 +23,8 @@ from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
 from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
 from deepspeed_tpu.inference.v2.ragged import BlockedAllocator
 from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
-from deepspeed_tpu.serving import (ContinuousBatchScheduler, Request,
-                                   RequestState, SamplingParams,
+from deepspeed_tpu.serving import (ContinuousBatchScheduler, QueueFullError,
+                                   Request, RequestState, SamplingParams,
                                    sample_batch)
 
 CFG = LlamaConfig.tiny(dtype=jnp.float32)
@@ -292,6 +293,56 @@ def test_submit_rejections(params):
     r = sched.submit([1, 2, 3])
     with pytest.raises(ValueError, match="already"):
         sched.submit([4, 5], uid=r.uid)
+    with pytest.raises(ValueError, match="deadline_s"):
+        sched.submit([1, 2], deadline_s=-1.0)
+
+
+def test_bounded_admission_queue_rejects_overload(params):
+    sched = ContinuousBatchScheduler(_engine(params), max_queue=2)
+    sched.submit([1, 2], sampling=SamplingParams(max_new_tokens=1))
+    sched.submit([3, 4], sampling=SamplingParams(max_new_tokens=1))
+    with pytest.raises(QueueFullError, match="max_queue=2"):
+        sched.submit([5, 6], sampling=SamplingParams(max_new_tokens=1))
+    assert sched.metrics.snapshot()["rejected"] == 1
+    sched.step()  # admits the queued pair -> admission reopens
+    r3 = sched.submit([5, 6], sampling=SamplingParams(max_new_tokens=1))
+    sched.run_until_idle(max_ticks=20)
+    assert r3.state is RequestState.FINISHED
+    with pytest.raises(ValueError, match="max_queue"):
+        ContinuousBatchScheduler(_engine(params), max_queue=0)
+
+
+def test_deadline_exceeded_fails_queued_request(params):
+    sched = ContinuousBatchScheduler(_engine(params))
+    ok = sched.submit([1, 2, 3], sampling=SamplingParams(max_new_tokens=2))
+    doomed = sched.submit([4, 5, 6],
+                          sampling=SamplingParams(max_new_tokens=64),
+                          deadline_s=0.01)
+    time.sleep(0.03)
+    sched.run_until_idle(max_ticks=50)
+    assert doomed.state is RequestState.FAILED
+    assert doomed.finish_reason == "deadline"
+    assert ok.state is RequestState.FINISHED
+    snap = sched.metrics.snapshot()
+    assert snap["deadline_exceeded"] == 1.0 and snap["failed"] == 1.0
+
+
+def test_deadline_exceeded_fails_running_request_and_frees_kv(params):
+    eng = _engine(params)
+    sched = ContinuousBatchScheduler(eng)
+    req = sched.submit(list(range(1, 9)),
+                       sampling=SamplingParams(max_new_tokens=64),
+                       deadline_s=0.05)
+    sched.step()
+    assert req.state in (RequestState.PREFILL, RequestState.DECODE)
+    time.sleep(0.06)
+    sched.step()
+    assert req.state is RequestState.FAILED
+    assert req.finish_reason == "deadline"
+    assert req.generated  # tokens emitted before the SLO blew stay visible
+    sm = eng.state_manager
+    assert sm.n_tracked_sequences == 0  # device KV fully released
+    assert sched.metrics.snapshot()["deadline_exceeded"] == 1.0
 
 
 # --------------------------------------------------------------------- #

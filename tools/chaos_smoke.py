@@ -1,0 +1,242 @@
+"""Crash-recovery smoke (~15 s CPU): train, get KILLED mid-save by an
+injected fault, restart, auto-resume, and prove bit-exact continuation.
+
+The flow:
+
+1. an uninterrupted reference run trains a tiny single-device model for
+   ``TOTAL_STEPS`` through :class:`ResilientTrainLoop` (checkpoint every
+   ``SAVE_INTERVAL`` steps);
+2. a subprocess repeats the run with
+   ``DS_CHAOS="crash_after_shard_write:after=1"`` armed — the process
+   hard-kills itself (``os._exit``) in the middle of its SECOND save;
+3. the parent asserts the crash left ``latest`` pointing at the previous,
+   fully verified tag (the atomic-commit invariant);
+4. a fresh loop in the same directory ``auto_resume()``s and trains to
+   completion; master weights, optimizer state, AND the post-resume loss
+   curve must match the uninterrupted run bit-exactly.
+
+Wired into tier-1 via ``tests/unit/test_resilience.py``.  Run standalone::
+
+    JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TOTAL_STEPS = 12
+SAVE_INTERVAL = 4
+CRASH_EXIT_CODE = 43
+
+
+class MiniEngine:
+    """Minimal single-device trainer exposing the reference checkpoint
+    surface (``state`` / ``_state_shardings`` / ``save_checkpoint`` /
+    ``load_checkpoint``), so the REAL atomic-commit and verified-load
+    paths are exercised without the multi-device mesh the full
+    ``DeepSpeedEngine`` needs.  Linear model + SGD-with-momentum; every
+    update is a pure jitted function of (state, batch), so a restored
+    checkpoint continues bit-exactly."""
+
+    def __init__(self, seed: int = 0, dim: int = 8):
+        import jax
+        import jax.numpy as jnp
+
+        key = jax.random.PRNGKey(seed)
+        w = jax.random.normal(key, (dim, dim), jnp.float32) * 0.1
+        b = jnp.zeros((dim,), jnp.float32)
+        zeros = {"w": jnp.zeros_like(w), "b": jnp.zeros_like(b)}
+        self.state = {
+            "step": jnp.zeros((), jnp.int32),
+            "opt_step": jnp.zeros((), jnp.int32),
+            "loss_scale": jnp.ones((), jnp.float32),
+            "good_steps": jnp.zeros((), jnp.int32),
+            "master": {"w": w, "b": b},
+            "params": {"w": w, "b": b},
+            "opt": {"mom": dict(zeros)},
+            "acc_grads": dict(zeros),
+        }
+        self.compute_dtype = jnp.float32
+        self.checkpoint_engine = None
+        self.global_steps = 0
+        self.losses = []
+
+        def update(master, opt, x, y):
+            def loss_fn(m):
+                pred = x @ m["w"] + m["b"]
+                return jnp.mean((pred - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(master)
+            mom = jax.tree.map(lambda v, g: 0.9 * v + g, opt["mom"], grads)
+            master = jax.tree.map(lambda p, v: p - 0.05 * v, master, mom)
+            return loss, master, {"mom": mom}
+
+        self._update = jax.jit(update)
+
+    def _state_shardings(self):
+        import jax
+
+        sd = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        return jax.tree.map(lambda _: sd, self.state)
+
+    def train_micro_batch(self, x, y):
+        import jax.numpy as jnp
+
+        loss, master, opt = self._update(
+            self.state["master"], self.state["opt"], x, y)
+        self.state["master"] = master
+        self.state["params"] = master
+        self.state["opt"] = opt
+        self.state["step"] = self.state["step"] + jnp.int32(1)
+        self.global_steps += 1
+        loss = float(loss)
+        self.losses.append(loss)
+        return loss
+
+    # -- reference checkpoint surface ---------------------------------- #
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        from deepspeed_tpu.checkpoint.engine import save_engine_state
+
+        tag = tag or f"global_step{self.global_steps}"
+        save_engine_state(self, save_dir, tag, dict(client_state or {}),
+                          save_latest=save_latest,
+                          checkpoint_engine=self.checkpoint_engine)
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, verify="full",
+                        fallback=True, metrics=None):
+        import jax
+
+        from deepspeed_tpu.checkpoint.engine import load_engine_state
+
+        path, client_state = load_engine_state(
+            self, load_dir, tag, checkpoint_engine=self.checkpoint_engine,
+            verify=verify, fallback=fallback, metrics=metrics)
+        if path is not None:
+            self.global_steps = int(jax.device_get(self.state["step"]))
+        return path, client_state
+
+
+def batch_fn(step: int):
+    """Deterministic per-step batch — the exact-fast-forward contract."""
+    import numpy as np
+
+    rng = np.random.default_rng(1000 + step)
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    y = rng.standard_normal((4, 8)).astype(np.float32)
+    return x, y
+
+
+def run_training(workdir: str, until_step: int = TOTAL_STEPS,
+                 save_interval: int = SAVE_INTERVAL):
+    from deepspeed_tpu.resilience import ResilientTrainLoop
+
+    engine = MiniEngine(seed=0)
+    loop = ResilientTrainLoop(engine, batch_fn, workdir,
+                              save_interval=save_interval, keep_last=2)
+    loop.run(until_step)
+    return engine, loop
+
+
+def _flat(tree):
+    import jax
+
+    from deepspeed_tpu.utils.tensors import tree_to_flat_dict
+
+    import numpy as np
+
+    return {k: np.asarray(v)
+            for k, v in tree_to_flat_dict(jax.device_get(tree)).items()}
+
+
+def run_smoke(tmpdir: str | None = None) -> dict:
+    import numpy as np
+
+    from deepspeed_tpu.resilience import manifest
+
+    owns_tmp = tmpdir is None
+    if owns_tmp:
+        tmpdir = tempfile.mkdtemp(prefix="chaos_smoke_")
+    ref_dir = os.path.join(tmpdir, "ref")
+    crash_dir = os.path.join(tmpdir, "crash")
+
+    # 1. uninterrupted reference run
+    ref_engine, _ = run_training(ref_dir)
+
+    # 2. a subprocess that kills itself (os._exit) mid-save of tag
+    #    global_step8 — after=1 skips the first shard write (the save at
+    #    step 4), so the crash lands inside the SECOND save
+    env = dict(os.environ)
+    env["DS_CHAOS"] = f"crash_after_shard_write:after=1," \
+                      f"exit_code={CRASH_EXIT_CODE}"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", crash_dir],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == CRASH_EXIT_CODE, (
+        f"child should have been chaos-killed with exit code "
+        f"{CRASH_EXIT_CODE}, got {proc.returncode}\n"
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}")
+
+    # 3. the atomic-commit invariant: latest points at the previous,
+    #    FULLY VERIFIED tag; the torn save exists only as a .tmp dir
+    latest = manifest.read_latest(crash_dir)
+    assert latest == f"global_step{SAVE_INTERVAL}", latest
+    ok, problems = manifest.verify_tag(os.path.join(crash_dir, latest))
+    assert ok, problems
+    assert os.path.isdir(os.path.join(
+        crash_dir, f"global_step{2 * SAVE_INTERVAL}.tmp")), \
+        "expected the torn save's staging dir"
+    assert not os.path.isdir(os.path.join(
+        crash_dir, f"global_step{2 * SAVE_INTERVAL}")), \
+        "torn tag must NOT have been committed"
+
+    # 4. restart: auto-resume and train to completion
+    res_engine, res_loop = run_training(crash_dir)
+    assert res_loop.metrics.resumes == 1
+    assert res_loop.step == TOTAL_STEPS
+
+    # bit-exact master weights AND optimizer state vs. uninterrupted
+    for name in ("master", "opt"):
+        want, got = _flat(ref_engine.state[name]), _flat(res_engine.state[name])
+        assert set(want) == set(got), (name, set(want) ^ set(got))
+        for k in want:
+            assert np.array_equal(want[k], got[k]), f"{name}/{k} diverged"
+    # loss-curve continuation: the resumed run's post-resume losses equal
+    # the reference's losses at the same steps
+    n = len(res_engine.losses)
+    assert n == TOTAL_STEPS - SAVE_INTERVAL, n
+    assert res_engine.losses == ref_engine.losses[-n:], "loss curve diverged"
+
+    return {
+        "ref_final_loss": ref_engine.losses[-1],
+        "resumed_final_loss": res_engine.losses[-1],
+        "resumed_from": latest,
+        "resumes": res_loop.metrics.resumes,
+        "saves": res_loop.metrics.saves,
+    }
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        # chaos (from DS_CHAOS) hard-kills this process mid-save
+        run_training(sys.argv[2])
+        return 0  # only reached if chaos failed to fire — parent asserts
+    t0 = time.monotonic()
+    snap = run_smoke()
+    snap["wall_s"] = round(time.monotonic() - t0, 2)
+    print(json.dumps({"chaos_smoke": "ok", **snap}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
