@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.resilience import chaos
+from deepspeed_tpu.resilience import chaos, heartbeat
 from deepspeed_tpu.utils.tensors import tree_to_flat_dict
 
 SHARD_FILE = "zero_pp_rank_{proc}_mp_rank_00_states.npz"
@@ -52,6 +52,9 @@ def write_npz(path: str, payload: Dict[str, np.ndarray]) -> str:
     with open(path, "rb") as f:
         os.fsync(f.fileno())
     chaos.fire("crash_after_shard_write", path=path)
+    # a completed shard write is real progress: keep the supervisor's
+    # hang detector fed through long multi-shard saves
+    heartbeat.tick_active()
     return path
 
 

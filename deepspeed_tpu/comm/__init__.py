@@ -1,4 +1,5 @@
 from deepspeed_tpu.comm.comm import (
+    CommTimeoutError,
     all_gather,
     all_gather_into_tensor,
     all_reduce,
@@ -23,6 +24,7 @@ from deepspeed_tpu.comm.comm import (
 from deepspeed_tpu.comm.xla_backend import ReduceOp
 
 __all__ = [
+    "CommTimeoutError",
     "ReduceOp", "init_distributed", "is_initialized", "get_rank",
     "get_world_size", "get_local_rank", "barrier", "destroy_process_group",
     "all_reduce", "inference_all_reduce", "all_gather",

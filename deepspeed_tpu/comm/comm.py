@@ -19,6 +19,7 @@ Two tiers, matching how TPU programs are actually structured:
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -30,6 +31,12 @@ from deepspeed_tpu.utils.logging import logger
 
 _backend: Optional[XlaBackend] = None
 _initialized = False
+
+
+class CommTimeoutError(RuntimeError):
+    """A host-level synchronization point (``barrier(timeout=...)``)
+    expired.  The descriptive alternative to deadlocking forever on a
+    hung or dead peer — supervisors catch this and restart the group."""
 
 
 def _get_backend() -> XlaBackend:
@@ -196,13 +203,53 @@ def get_local_rank() -> int:
     return int(os.environ.get("LOCAL_RANK", 0))
 
 
-def barrier(group=None) -> None:
+def _sync_global(tag: str) -> None:
+    """The blocking cross-host sync (factored out so tests can simulate a
+    hung peer without a real multi-process group)."""
     import jax
 
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
+        multihost_utils.sync_global_devices(tag)
+
+
+def barrier(group=None, timeout: Optional[float] = None,
+            tag: str = "deepspeed_tpu.barrier") -> None:
+    """Host-level barrier.  With ``timeout`` (seconds), a peer that never
+    arrives raises :class:`CommTimeoutError` instead of deadlocking this
+    process at the dispatch level — the failure a job supervisor can act
+    on.  The abandoned sync runs out its course on a daemon thread (the
+    underlying rendezvous has no cancellation API), so a process that
+    chooses to continue after the error must re-synchronize with a fresh
+    tag."""
+    if timeout is None:
+        return _sync_global(tag)
+    if timeout <= 0:
+        raise ValueError(f"barrier timeout must be > 0, got {timeout}")
+    done = threading.Event()
+    errs: list = []
+
+    def _run():
+        try:
+            _sync_global(tag)
+        except BaseException as e:  # noqa: BLE001 — surfaced to caller
+            errs.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, name=f"ds-barrier-{tag}", daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        import jax
+
+        raise CommTimeoutError(
+            f"barrier {tag!r} timed out after {timeout}s waiting for "
+            f"{jax.process_count()} process(es): a peer is hung or dead "
+            "(a supervisor should tear down and restart the worker group; "
+            "this process's sync thread is abandoned)")
+    if errs:
+        raise errs[0]
 
 
 def destroy_process_group() -> None:
@@ -223,10 +270,33 @@ def _log(op_name: str, tensor, group) -> None:
         lg.append(op_name, nbytes, group=group)
 
 
+def _dispatch(op_name: str, axes, thunk):
+    """Run one collective, translating JAX's bare ``NameError: unbound
+    axis name`` — what an eager call outside any mesh context produces —
+    into an actionable error that names :func:`init_distributed`.  Inside
+    ``shard_map`` (axis names bound) this adds nothing to the hot path
+    beyond the try frame."""
+    try:
+        return thunk()
+    except NameError as e:
+        if "axis name" not in str(e):
+            raise          # a genuine NameError bug, not an unbound axis
+        raise RuntimeError(
+            f"comm.{op_name}(group={axes!r}) was called where no mesh axis "
+            f"is bound ({e}). Collectives are in-graph: call "
+            "deepspeed_tpu.init_distributed() first and invoke them inside "
+            "the engine's shard_map/mesh context — not eagerly at top "
+            "level." + ("" if is_initialized() else
+                        " (init_distributed has NOT been called in this "
+                        "process.)")) from e
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, async_op: bool = False):
     axes = resolve_group(group)
     _log("all_reduce", tensor, axes)
-    return _get_backend().all_reduce(tensor, op=op, group=axes)
+    return _dispatch("all_reduce", axes,
+                     lambda: _get_backend().all_reduce(tensor, op=op,
+                                                       group=axes))
 
 
 def inference_all_reduce(tensor, group=None):
@@ -236,7 +306,9 @@ def inference_all_reduce(tensor, group=None):
 def all_gather(tensor, group=None, axis: int = 0, async_op: bool = False):
     axes = resolve_group(group)
     _log("all_gather", tensor, axes)
-    return _get_backend().all_gather(tensor, group=axes, axis=axis)
+    return _dispatch("all_gather", axes,
+                     lambda: _get_backend().all_gather(tensor, group=axes,
+                                                       axis=axis))
 
 
 # reference names all_gather_into_tensor / allgather_fn
@@ -247,7 +319,10 @@ def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, axis: int = 0,
                    async_op: bool = False):
     axes = resolve_group(group)
     _log("reduce_scatter", tensor, axes)
-    return _get_backend().reduce_scatter(tensor, op=op, group=axes, axis=axis)
+    return _dispatch("reduce_scatter", axes,
+                     lambda: _get_backend().reduce_scatter(tensor, op=op,
+                                                           group=axes,
+                                                           axis=axis))
 
 
 reduce_scatter_tensor = reduce_scatter
@@ -257,14 +332,18 @@ def all_to_all_single(tensor, group=None, split_axis: int = 0,
                       concat_axis: int = 0, async_op: bool = False):
     axes = resolve_group(group if group is not None else "sp")
     _log("all_to_all_single", tensor, axes)
-    return _get_backend().all_to_all(tensor, group=axes, split_axis=split_axis,
-                                     concat_axis=concat_axis)
+    return _dispatch("all_to_all_single", axes,
+                     lambda: _get_backend().all_to_all(
+                         tensor, group=axes, split_axis=split_axis,
+                         concat_axis=concat_axis))
 
 
 def broadcast(tensor, src: int = 0, group=None, async_op: bool = False):
     axes = resolve_group(group)
     _log("broadcast", tensor, axes)
-    return _get_backend().broadcast(tensor, src=src, group=axes)
+    return _dispatch("broadcast", axes,
+                     lambda: _get_backend().broadcast(tensor, src=src,
+                                                      group=axes))
 
 
 def ppermute(tensor, perm: Sequence[Tuple[int, int]], group="pp"):
@@ -272,15 +351,20 @@ def ppermute(tensor, perm: Sequence[Tuple[int, int]], group="pp"):
     the idiomatic form is a collective-permute over the pipe axis."""
     axes = resolve_group(group)
     _log("ppermute", tensor, axes)
-    return _get_backend().permute(tensor, perm, group=axes)
+    return _dispatch("ppermute", axes,
+                     lambda: _get_backend().permute(tensor, perm, group=axes))
 
 
 def axis_index(group=None):
-    return _get_backend().axis_index(resolve_group(group))
+    axes = resolve_group(group)
+    return _dispatch("axis_index", axes,
+                     lambda: _get_backend().axis_index(axes))
 
 
 def axis_size(group=None) -> int:
-    return _get_backend().axis_size(resolve_group(group))
+    axes = resolve_group(group)
+    return _dispatch("axis_size", axes,
+                     lambda: _get_backend().axis_size(axes))
 
 
 # --------------------------------------------------------------------- #

@@ -75,6 +75,10 @@ def main(args=None) -> int:
 
     signal.signal(signal.SIGTERM, _terminate)
     signal.signal(signal.SIGINT, _terminate)
+    # the runner launches remote copies over `ssh -tt`: when the local ssh
+    # client dies, sshd hangs up the session — treat it like SIGTERM so a
+    # dropped connection can never orphan the worker group
+    signal.signal(signal.SIGHUP, _terminate)
 
     cmd = _child_cmd(args)
     for i, slot in enumerate(local_slots):

@@ -23,8 +23,11 @@ import base64
 import json
 import os
 import shlex
+import signal
 import subprocess
 import sys
+import threading
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
@@ -32,6 +35,10 @@ from deepspeed_tpu.utils.logging import logger
 
 from deepspeed_tpu.launcher.constants import (DLTS_HOSTFILE,  # noqa: F401
                                               EXPORT_ENVS)
+# imported at module scope: _signal_group runs inside SIGINT/SIGTERM
+# handlers, where a first-time package import could itself fail and
+# abort the teardown mid-flight
+from deepspeed_tpu.resilience.supervisor import signal_process_group
 
 
 def parse_args(args=None):
@@ -68,8 +75,16 @@ def parse_args(args=None):
     parser.add_argument("--min_elastic_nodes", type=int, default=-1)
     parser.add_argument("--max_elastic_nodes", type=int, default=-1)
     parser.add_argument("--max_restarts", type=int, default=3,
-                        help="elastic: relaunch attempts after a failed "
-                        "worker group (reference DSElasticAgent restarts)")
+                        help="elastic: relaunch budget after failed worker "
+                        "groups, counted over a sliding --restart_window_s "
+                        "window (reference DSElasticAgent restarts)")
+    parser.add_argument("--restart_backoff_s", type=float, default=1.0,
+                        help="elastic: base of the exponential backoff "
+                        "between relaunches (grows with the number of "
+                        "restarts inside the window, plus jitter)")
+    parser.add_argument("--restart_window_s", type=float, default=300.0,
+                        help="elastic: sliding window for --max_restarts; "
+                        "a long-healthy job earns its budget back")
     parser.add_argument("--save_pid", action="store_true")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -211,9 +226,115 @@ def build_multinode_cmds(args, world_info: Dict[str, List[int]],
         launch = build_launch_cmd(args, world_info, rank, master_addr)
         remote = f"cd {shlex.quote(os.getcwd())} && {env_exports} " + \
             " ".join(shlex.quote(c) for c in launch)
-        cmds.append(["ssh"] + shlex.split(args.launcher_args) +
+        # -tt: force a tty so that killing the LOCAL ssh client (wait_all
+        # sibling teardown, Ctrl-C) hangs up the remote session — sshd
+        # then SIGHUPs the remote launch, which tears down its workers.
+        # Without a tty the remote tree survives client death until it
+        # happens to write to the dead socket, and a new elastic wave
+        # could overlap the old one.
+        cmds.append(["ssh", "-tt"] + shlex.split(args.launcher_args) +
                     [host, remote])
     return cmds
+
+
+# ------------------------------------------------------------------ #
+# Process-group supervision of the node launchers
+# ------------------------------------------------------------------ #
+_signal_group = signal_process_group
+
+
+def wait_all(procs: Optional[List[subprocess.Popen]] = None,
+             poll_s: float = 0.1,
+             term_grace_s: float = 10.0,
+             signal_state: Optional[dict] = None,
+             spawn: Optional[List[List[str]]] = None) -> int:
+    """Wait on every node launcher *concurrently*.
+
+    The first NONZERO exit terminates the surviving siblings (SIGTERM to
+    each process group, SIGKILL after ``term_grace_s``) — a serial
+    ``wait()`` would let one hung sibling block the next elastic wave
+    forever.  SIGINT/SIGTERM delivered to the runner are forwarded to all
+    child process groups, so Ctrl-C never orphans workers; the runner then
+    exits ``128 + signum``.  Returns the first failure's exit code (0 when
+    every launcher exited cleanly).
+
+    ``signal_state``: optional dict; when the RUNNER itself receives a
+    signal, ``signal_state["signum"]`` is set.  This is the only reliable
+    operator-stop channel — a remote worker group killed by SIGTERM also
+    produces exit code 143 through ssh, and that one SHOULD be restarted
+    by the elastic loop.
+
+    ``spawn``: commands to launch (``start_new_session=True``) AFTER the
+    signal forwarders are installed — the children live in their own
+    sessions, so a Ctrl-C landing mid-spawn would otherwise orphan the
+    ones already started (the terminal can no longer reach them)."""
+    procs = list(procs) if procs is not None else []
+    state = {"rc": 0, "sig_rc": 0, "kill_deadline": None}
+
+    def _teardown(sig: int) -> None:
+        for p in procs:
+            if p.poll() is None:
+                _signal_group(p, sig)
+        if state["kill_deadline"] is None:
+            state["kill_deadline"] = time.monotonic() + term_grace_s
+
+    def _forward(signum, frame):
+        state["sig_rc"] = 128 + signum
+        if signal_state is not None:
+            signal_state["signum"] = signum
+        _teardown(signum)
+
+    # Signal handlers only exist on the main thread; a library caller on a
+    # worker thread still gets the concurrent-wait + sibling-teardown
+    # semantics, just not signal forwarding.
+    old_handlers = {}
+    if threading.current_thread() is threading.main_thread():
+        for s in (signal.SIGINT, signal.SIGTERM):
+            old_handlers[s] = signal.signal(s, _forward)
+    try:
+        for cmd in spawn or ():
+            if state["sig_rc"]:
+                break          # signalled mid-spawn: launch no more
+            try:
+                procs.append(subprocess.Popen(cmd, start_new_session=True))
+            except OSError as e:
+                # fork/exec failure (EAGAIN, ENOMEM, missing binary):
+                # already-started launchers are in their own sessions and
+                # would outlive a propagated exception — tear them down
+                # and report a failure the elastic loop can retry
+                logger.error(f"failed to spawn {' '.join(cmd)}: {e}; "
+                             f"terminating {len(procs)} already-started "
+                             f"launcher(s)")
+                state["rc"] = 1
+                _teardown(signal.SIGTERM)
+                break
+        pending = list(procs)
+        while pending:
+            for p in list(pending):
+                r = p.poll()
+                if r is None:
+                    continue
+                pending.remove(p)
+                if r != 0 and state["rc"] == 0 and state["sig_rc"] == 0:
+                    state["rc"] = r
+                    logger.error(
+                        f"node launcher {p.pid} exited rc={r}; "
+                        f"terminating {len(pending)} surviving sibling(s)")
+                    _teardown(signal.SIGTERM)
+            if not pending:
+                break
+            if state["kill_deadline"] is not None and \
+                    time.monotonic() > state["kill_deadline"]:
+                for p in pending:
+                    logger.error(f"node launcher {p.pid} ignored SIGTERM "
+                                 f"for {term_grace_s}s; escalating SIGKILL")
+                    _signal_group(p, signal.SIGKILL)
+                state["kill_deadline"] = float("inf")
+            time.sleep(poll_s)
+    finally:
+        for s, h in old_handlers.items():
+            signal.signal(s, h)
+    return state["sig_rc"] or state["rc"]
 
 
 # ------------------------------------------------------------------ #
@@ -262,11 +383,28 @@ def _resolve_world(args) -> Dict[str, List[int]]:
     return world_info
 
 
-def main(args=None) -> int:
-    args = parse_args(args)
+def main(args=None, metrics=None) -> int:
+    # DS_ELASTIC_NODE_RANGE is an env channel to the children (read by
+    # ElasticityConfig); restore it on exit so an in-process caller (the
+    # test suite, a notebook) is not left with a stale node range
+    saved_range = os.environ.get("DS_ELASTIC_NODE_RANGE")
+    try:
+        return _main(parse_args(args), metrics)
+    finally:
+        if saved_range is None:
+            os.environ.pop("DS_ELASTIC_NODE_RANGE", None)
+        else:
+            os.environ["DS_ELASTIC_NODE_RANGE"] = saved_range
 
-    def launch_once() -> int:
-        world_info = _resolve_world(args)
+
+def _main(args, metrics=None) -> int:
+    last_world = {"procs": 0}
+
+    def launch_once(world_info: Optional[Dict[str, List[int]]] = None,
+                    signal_state: Optional[dict] = None) -> int:
+        if world_info is None:
+            world_info = _resolve_world(args)
+        last_world["procs"] = sum(len(s) for s in world_info.values())
         master_addr = args.master_addr or next(iter(world_info))
         from deepspeed_tpu.launcher.multinode_runner import RUNNERS
 
@@ -274,42 +412,85 @@ def main(args=None) -> int:
         multi = (len(world_info) > 1 or args.force_multi or scheduler) and \
             args.launcher != "local"
         if not multi:
-            cmd = build_launch_cmd(args, world_info, 0, master_addr or
-                                   "localhost")
-            logger.info(f"launching: {' '.join(cmd)}")
-            return subprocess.call(cmd)
-        cmds = build_multinode_cmds(args, world_info, master_addr)
-        procs = [subprocess.Popen(c) for c in cmds]
-        # wait EVERY node launcher (keep the first failure's code): the
-        # next elastic wave must not start while old workers are alive
-        rc = 0
-        for p in procs:
-            r = p.wait()
-            if r != 0 and rc == 0:
-                rc = r
-        return rc
+            cmds = [build_launch_cmd(args, world_info, 0, master_addr or
+                                     "localhost")]
+        else:
+            cmds = build_multinode_cmds(args, world_info, master_addr)
+        logger.info("launching: " +
+                    " | ".join(" ".join(c) for c in cmds))
+        # wait_all spawns them (own session per node launcher, so
+        # teardown can killpg the whole remote-command tree) only after
+        # its signal forwarders are live, and supervises all at once
+        return wait_all(spawn=cmds, signal_state=signal_state)
 
     if not args.elastic_training:
         return launch_once()
 
     # Elastic restart loop (reference elasticity/elastic_agent.py:28
-    # DSElasticAgent._invoke_run): a failed worker group is relaunched up
-    # to --max_restarts times; workers resume from their checkpoints
-    # (elastic batch algebra keeps convergence intact across restarts).
+    # DSElasticAgent._invoke_run): a failed worker group is relaunched
+    # under the supervisor's backoff + sliding-window budget policy;
+    # workers resume from their checkpoints (elastic batch algebra keeps
+    # convergence intact across restarts).  The launcher only observes
+    # exit codes, so every restart here has reason "crash" — hang
+    # detection lives in resilience.supervisor.JobSupervisor, which owns
+    # worker heartbeats.
+    from deepspeed_tpu.resilience.metrics import ResilienceMetrics
+    from deepspeed_tpu.resilience.supervisor import (BackoffPolicy,
+                                                     RestartBudget)
+
+    metrics = metrics if metrics is not None else ResilienceMetrics()
+    base_s = max(args.restart_backoff_s, 0.0)
+    backoff = BackoffPolicy(base_s=base_s, max_s=max(60.0, base_s))
+    budget = RestartBudget(max(args.max_restarts, 0),
+                           args.restart_window_s)
     attempt = 0
+    next_world: Optional[Dict[str, List[int]]] = None
     while True:
-        rc = launch_once()
+        sig_state: dict = {}
+        rc = launch_once(next_world, signal_state=sig_state)
+        next_world = None
         if rc == 0:
             return 0
-        attempt += 1
-        if attempt > max(args.max_restarts, 0):
-            logger.error(
-                f"elastic training: worker group failed rc={rc} after "
-                f"{attempt - 1} restart(s); giving up")
+        if sig_state.get("signum") is not None:
+            # the RUNNER itself was signalled (wait_all's signal_state
+            # channel — NOT a remote worker group that happened to exit
+            # 143, which should be restarted): an operator stop is not a
+            # crashed worker group, do not respawn against a Ctrl-C
+            logger.warning(
+                f"elastic training: stopped by operator signal "
+                f"(rc={rc}); not restarting")
             return rc
+        now = time.monotonic()
+        if budget.exhausted(now):
+            logger.error(
+                f"elastic training: worker group failed rc={rc}; restart "
+                f"budget exhausted ({budget.in_window(now)}/"
+                f"{budget.max_restarts} within {budget.window_s}s); "
+                f"giving up after {attempt} restart(s)")
+            return rc
+        world_before = last_world["procs"]
+        budget.record(now)
+        attempt += 1
+        delay = backoff.delay(budget.in_window(now) - 1)
         logger.warning(
             f"elastic training: worker group failed rc={rc}; restart "
-            f"{attempt}/{args.max_restarts}")
+            f"{attempt} (budget {budget.in_window(now)}/"
+            f"{budget.max_restarts} in window) in {delay:.2f}s")
+        time.sleep(delay)
+        # resolve the next wave's world ONCE, after the backoff (the
+        # window in which an operator drains dead hosts from the
+        # hostfile), and launch exactly what the metric reports
+        try:
+            next_world = _resolve_world(args)
+        except ValueError as e:
+            logger.error(f"elastic training: no viable world left after "
+                         f"failure rc={rc}: {e}")
+            return rc
+        world_after = sum(len(s) for s in next_world.values())
+        metrics.record_restart(reason="crash", attempt=attempt,
+                               backoff_s=delay, world_before=world_before,
+                               world_after=world_after)
+        metrics.export()
 
 
 if __name__ == "__main__":

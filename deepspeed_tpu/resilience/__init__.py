@@ -11,13 +11,28 @@ The pieces (used together by the checkpoint path and
   testable rather than asserted.
 * :mod:`.loop` — :class:`ResilientTrainLoop`: periodic commits,
   ``auto_resume()``, retention GC, and the NaN/loss-spike sentinel.
+* :mod:`.heartbeat` — the worker-side liveness protocol (file-mtime
+  beats + SIGUSR1 stack dumps) the supervisor's hang detector reads.
+* :mod:`.supervisor` — :class:`JobSupervisor`: the detect → kill →
+  resize → resume loop over worker processes, with exponential backoff,
+  a sliding-window restart budget, and host blacklisting.
 * :mod:`.metrics` — ``resilience/*`` monitor series.
 """
 
 from deepspeed_tpu.resilience import chaos, manifest
 from deepspeed_tpu.resilience.chaos import ChaosInjectedError
+from deepspeed_tpu.resilience.heartbeat import (Heartbeat, HeartbeatInfo,
+                                                install_stack_dump,
+                                                read_heartbeat)
 from deepspeed_tpu.resilience.loop import ResilientTrainLoop, apply_retention
 from deepspeed_tpu.resilience.metrics import ResilienceMetrics
+from deepspeed_tpu.resilience.supervisor import (BackoffPolicy,
+                                                 HostBlacklist,
+                                                 JobSupervisor,
+                                                 RestartBudget, WorkerSpec)
 
-__all__ = ["ChaosInjectedError", "ResilienceMetrics", "ResilientTrainLoop",
-           "apply_retention", "chaos", "manifest"]
+__all__ = ["BackoffPolicy", "ChaosInjectedError", "Heartbeat",
+           "HeartbeatInfo", "HostBlacklist", "JobSupervisor",
+           "ResilienceMetrics", "ResilientTrainLoop", "RestartBudget",
+           "WorkerSpec", "apply_retention", "chaos", "install_stack_dump",
+           "manifest", "read_heartbeat"]

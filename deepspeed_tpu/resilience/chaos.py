@@ -7,7 +7,7 @@ and the chaos smoke tool arm faults to prove the crash-recovery invariants
 verified tag; silent corruption is detected at load) instead of asserting
 them.
 
-Fault points (all live in :mod:`deepspeed_tpu.checkpoint.engine`):
+Checkpoint-path fault points (in :mod:`deepspeed_tpu.checkpoint.engine`):
 
 ``slow_io``
     before a shard file's bytes are written (default action: ``sleep``).
@@ -21,9 +21,29 @@ Fault points (all live in :mod:`deepspeed_tpu.checkpoint.engine`):
     after the tag directory is renamed into place but before the
     ``latest`` pointer is republished (default: ``crash``).
 
+Supervision fault points (the failure modes
+:class:`~deepspeed_tpu.resilience.supervisor.JobSupervisor` exists to
+survive; fired per step by :class:`ResilientTrainLoop`, per beat by
+:class:`~deepspeed_tpu.resilience.heartbeat.Heartbeat`):
+
+``worker_crash``
+    at a step boundary in the training loop (default: ``crash`` — the
+    clean-ish failure mode: nonzero exit the supervisor sees via wait).
+``worker_hang``
+    at a step boundary (default: ``hang`` — the process stops making
+    progress but stays alive: heartbeats go stale, nothing exits).
+``heartbeat_stall``
+    inside :meth:`Heartbeat.beat` (default: ``drop`` — the beat is
+    suppressed while the worker keeps computing, modelling a wedged
+    heartbeat thread / stalled NFS mount; the supervisor must treat the
+    stale file as a hang).
+
 Actions: ``crash`` (``os._exit``, for subprocess kill tests), ``raise``
 (:class:`ChaosInjectedError`, for in-process tests), ``corrupt`` (flip one
-byte of the file at the fault point's ``path``), ``sleep``.
+byte of the file at the fault point's ``path``), ``sleep``, ``hang``
+(block forever — only a supervisor SIGTERM/SIGKILL ends it), ``drop``
+(suppress the instrumented operation: ``fire`` returns True and the call
+site skips its work).
 
 Arming: :func:`arm` / :func:`disarm` / the :func:`inject` context manager,
 or the ``DS_CHAOS`` environment variable for subprocesses, e.g.::
@@ -50,6 +70,9 @@ FAULT_POINTS: Dict[str, str] = {
     "crash_after_shard_write": "crash",
     "corrupt_shard_bytes": "corrupt",
     "fail_latest_publish": "crash",
+    "worker_crash": "crash",
+    "worker_hang": "hang",
+    "heartbeat_stall": "drop",
 }
 
 ENV_VAR = "DS_CHAOS"
@@ -81,7 +104,7 @@ def arm(point: str, action: Optional[str] = None, **kwargs) -> Fault:
         raise ValueError(f"unknown fault point {point!r}; "
                          f"known: {sorted(FAULT_POINTS)}")
     action = action or FAULT_POINTS[point]
-    if action not in ("crash", "raise", "corrupt", "sleep"):
+    if action not in ("crash", "raise", "corrupt", "sleep", "hang", "drop"):
         raise ValueError(f"unknown chaos action {action!r}")
     fault = Fault(point=point, action=action, **kwargs)
     _armed[point] = fault
@@ -156,20 +179,23 @@ def _flip_byte(path: str) -> None:
         os.fsync(f.fileno())
 
 
-def fire(point: str, path: Optional[str] = None) -> None:
-    """The fault point itself: a no-op unless ``point`` is armed."""
+def fire(point: str, path: Optional[str] = None) -> bool:
+    """The fault point itself: a no-op unless ``point`` is armed.
+    Returns True when a fault fired (the ``drop`` contract: the call site
+    skips the instrumented operation on True)."""
     _load_env_once()
     fault = _armed.get(point)
     if fault is None:
-        return
+        return False
     fault.hits += 1
     if fault.hits <= fault.after:
-        return
+        return False
     if fault.count and fault.fires >= fault.count:
-        return
+        return False
     fault.fires += 1
-    logger.warning(f"chaos: firing {point} (action={fault.action}, "
-                   f"hit={fault.hits}, path={path})")
+    if fault.fires == 1 or fault.count != 0:
+        logger.warning(f"chaos: firing {point} (action={fault.action}, "
+                       f"hit={fault.hits}, path={path})")
     if fault.action == "sleep":
         time.sleep(fault.sleep_s)
     elif fault.action == "corrupt":
@@ -178,5 +204,13 @@ def fire(point: str, path: Optional[str] = None) -> None:
     elif fault.action == "crash":
         # simulate a hard kill: no cleanup handlers, no flushing
         os._exit(fault.exit_code)
+    elif fault.action == "hang":
+        # a wedged worker: alive (heartbeats may even continue from other
+        # threads) but never progressing — only SIGTERM/SIGKILL ends this
+        while True:
+            time.sleep(3600)
+    elif fault.action == "drop":
+        return True
     else:
         raise ChaosInjectedError(f"chaos fault injected at {point!r}")
+    return True

@@ -36,7 +36,8 @@ import time
 from collections import deque
 from typing import Any, Callable, Iterable, List, Optional, Set, Union
 
-from deepspeed_tpu.resilience import manifest
+from deepspeed_tpu.resilience import chaos, heartbeat, manifest
+from deepspeed_tpu.resilience.heartbeat import Heartbeat
 from deepspeed_tpu.resilience.metrics import ResilienceMetrics
 from deepspeed_tpu.utils.logging import logger
 
@@ -62,6 +63,7 @@ def apply_retention(save_dir: str, keep_last: int = 3, keep_every: int = 0,
         if info.tag not in keep:
             shutil.rmtree(info.path, ignore_errors=True)
             deleted.append(info.tag)
+            heartbeat.tick_active()   # a slow sweep is progress, not a hang
     if os.path.isdir(save_dir):
         for name in os.listdir(save_dir):
             if name.endswith(manifest.TMP_SUFFIX):
@@ -93,7 +95,8 @@ class ResilientTrainLoop:
                  max_rollbacks: int = 2,
                  monitor=None,
                  metrics: Optional[ResilienceMetrics] = None,
-                 export_every: int = 0):
+                 export_every: int = 0,
+                 heartbeat: Optional[Heartbeat] = None):
         if save_interval < 1:
             raise ValueError("save_interval must be >= 1")
         self.engine = engine
@@ -123,6 +126,10 @@ class ResilientTrainLoop:
         #: ground), or a fully poisoned tail would never trip the abort
         self._consecutive_rollbacks = 0
         self._last_good_tag: Optional[str] = None
+        #: liveness ticker for the job supervisor's hang detector; picked
+        #: up from the supervisor's env contract when not given explicitly
+        self.heartbeat = heartbeat if heartbeat is not None \
+            else Heartbeat.from_env()
 
     @staticmethod
     def _default_step_fn(engine, batch) -> float:
@@ -254,6 +261,12 @@ class ResilientTrainLoop:
         if auto_resume:
             self.auto_resume()
         while self.step < until_step:
+            # per-step liveness + the supervision fault points (free
+            # no-ops unless a chaos test armed them)
+            if self.heartbeat is not None:
+                self.heartbeat.beat(self.step)
+            chaos.fire("worker_crash")
+            chaos.fire("worker_hang")
             batch = self._next_batch(self.step)
             if self.step in self._skipped:
                 self.metrics.record_skip(self.step)

@@ -26,7 +26,7 @@ import shutil
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
-from deepspeed_tpu.resilience import chaos
+from deepspeed_tpu.resilience import chaos, heartbeat
 from deepspeed_tpu.utils.logging import logger
 
 MANIFEST = "manifest.json"
@@ -42,6 +42,7 @@ def fsync_dir(path: str) -> None:
         os.fsync(fd)
     finally:
         os.close(fd)
+    heartbeat.tick_active()
 
 
 def file_crc32(path: str) -> int:
@@ -52,6 +53,11 @@ def file_crc32(path: str) -> int:
             if not chunk:
                 break
             crc = zlib.crc32(chunk, crc)
+            # every checksummed chunk is progress — a multi-GB shard's
+            # CRC must not read as a hang to the supervisor, while a
+            # single wedged read() still goes stale (the tick is
+            # throttled, so this costs nothing on small files)
+            heartbeat.tick_active()
     return crc & 0xFFFFFFFF
 
 

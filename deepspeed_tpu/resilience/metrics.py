@@ -1,7 +1,10 @@
-"""Resilience telemetry: save latency, verify failures, resumes, rollbacks.
+"""Resilience telemetry: save latency, verify failures, resumes, rollbacks,
+and the supervision series (restarts by reason, hangs, SIGKILL
+escalations, blacklisted hosts, world size).
 
-Mirrors :class:`~deepspeed_tpu.serving.metrics.ServingMetrics`: the loop
-and the verified loader call ``record_*`` hooks; ``export()`` pushes
+Mirrors :class:`~deepspeed_tpu.serving.metrics.ServingMetrics`: the loop,
+the verified loader, and :class:`~deepspeed_tpu.resilience.supervisor.
+JobSupervisor` call ``record_*`` hooks; ``export()`` pushes
 ``resilience/*`` scalars through the existing monitor fan-out with a
 wall-clock float x (the writers already accept float steps).
 """
@@ -25,6 +28,16 @@ class ResilienceMetrics:
         self.rollbacks = 0
         self.skipped_steps = 0
         self.gc_deleted_tags = 0
+        # supervision (JobSupervisor / the launcher's elastic loop)
+        self.restarts = 0
+        self.restart_crash = 0
+        self.restart_hang = 0
+        self.restart_attempt = 0
+        self.last_restart_backoff_s = 0.0
+        self.hangs = 0
+        self.escalations = 0
+        self.blacklisted_hosts = 0
+        self.world_size = 0
 
     # -- hooks ---------------------------------------------------------- #
     def record_save(self, latency_s: float) -> None:
@@ -53,6 +66,29 @@ class ResilienceMetrics:
     def record_gc(self, deleted: int) -> None:
         self.gc_deleted_tags += deleted
 
+    # -- supervision hooks ---------------------------------------------- #
+    def record_restart(self, reason: str, attempt: int, backoff_s: float,
+                       world_before: int, world_after: int) -> None:
+        """One worker-group restart (reason: "crash" | "hang")."""
+        self.restarts += 1
+        if reason == "crash":
+            self.restart_crash += 1
+        elif reason == "hang":
+            self.restart_hang += 1
+        self.restart_attempt = int(attempt)
+        self.last_restart_backoff_s = float(backoff_s)
+        self.world_size = int(world_after)
+
+    def record_hang(self, host: str, age_s: float) -> None:
+        self.hangs += 1
+
+    def record_escalation(self, host: str) -> None:
+        """A worker ignored SIGTERM and had to be SIGKILLed."""
+        self.escalations += 1
+
+    def record_blacklist(self, host: str) -> None:
+        self.blacklisted_hosts += 1
+
     # -- aggregates ----------------------------------------------------- #
     def mean_save_latency_s(self) -> float:
         return self.total_save_latency_s / max(self.saves, 1)
@@ -69,6 +105,15 @@ class ResilienceMetrics:
             "rollbacks": float(self.rollbacks),
             "skipped_steps": float(self.skipped_steps),
             "gc_deleted_tags": float(self.gc_deleted_tags),
+            "restart_total": float(self.restarts),
+            "restart_crash": float(self.restart_crash),
+            "restart_hang": float(self.restart_hang),
+            "restart_attempt": float(self.restart_attempt),
+            "restart_backoff_s": self.last_restart_backoff_s,
+            "hangs": float(self.hangs),
+            "escalations": float(self.escalations),
+            "blacklisted_hosts": float(self.blacklisted_hosts),
+            "world_size": float(self.world_size),
         }
 
     def export(self, monitor=None,

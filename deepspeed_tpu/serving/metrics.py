@@ -45,6 +45,7 @@ class ServingMetrics:
         self.finished = 0
         self.failed = 0
         self.deadline_exceeded = 0       # failed with reason "deadline"
+        self.shutdown_failed = 0         # failed with reason "shutdown"
         self.preemptions = 0
         self.preempted_requests = 0      # ever preempted (incl. in-flight)
         self._terminal_preempted = 0     # preempted AND reached a terminal state
@@ -78,6 +79,8 @@ class ServingMetrics:
             self.failed += 1
             if req.finish_reason == "deadline":
                 self.deadline_exceeded += 1
+            elif req.finish_reason == "shutdown":
+                self.shutdown_failed += 1
             return
         self.finished += 1
         self.total_tokens += len(req.generated)
@@ -125,6 +128,7 @@ class ServingMetrics:
             "finished": float(self.finished),
             "failed": float(self.failed),
             "deadline_exceeded": float(self.deadline_exceeded),
+            "shutdown_failed": float(self.shutdown_failed),
             "preemptions": float(self.preemptions),
             "preempted_requests": float(self.preempted_requests),
             "preemption_rate": self.preemption_rate(),

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.resilience.heartbeat import Heartbeat
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import (Request, RequestState,
                                            SamplingParams)
@@ -75,6 +76,11 @@ class ContinuousBatchScheduler:
         self._uid_counter = itertools.count(1)
         self._admit_counter = itertools.count()
         self._tick = 0
+        #: set by shutdown(): admission is closed for good
+        self._shutting_down = False
+        #: liveness ticker for the job supervisor's hang detector (one
+        #: beat per scheduler tick; a wedged engine forward goes stale)
+        self._heartbeat = Heartbeat.from_env()
 
     # ------------------------------------------------------------------ #
     # Submission
@@ -101,6 +107,11 @@ class ContinuousBatchScheduler:
                 sampling=sampling or SamplingParams(),
                 priority=priority, deadline_s=deadline_s,
                 on_token=on_token)
+        if self._shutting_down:
+            self.metrics.record_reject(request)
+            raise RuntimeError(
+                f"submit: scheduler is shutting down — request "
+                f"{request.uid} rejected (admission closed)")
         if request.state is not RequestState.QUEUED:
             raise ValueError(f"submit: request {request.uid} already "
                              f"{request.state.value}")
@@ -153,6 +164,8 @@ class ContinuousBatchScheduler:
     def step(self) -> List[Tuple[Request, int]]:
         """Pack one engine forward and sample its logits.  Returns the
         ``(request, token)`` pairs emitted this tick."""
+        if self._heartbeat is not None:
+            self._heartbeat.beat(self._tick)
         self._expire_deadlines()
         self._reap_unservable()
         uids: List[int] = []
@@ -421,6 +434,27 @@ class ContinuousBatchScheduler:
             elif len(reqs) < n:
                 time.sleep(min(arrivals[len(reqs)] - now, poll_s))
         return reqs
+
+    def shutdown(self, drain_deadline: float = 30.0) -> bool:
+        """Graceful shutdown: close admission immediately (``submit``
+        raises from now on), let in-flight work finish via :meth:`drain`,
+        then fail whatever is still pending with reason ``"shutdown"``
+        (counted in the ``serving/shutdown_failed`` metric).  Returns True
+        when everything drained within ``drain_deadline`` seconds —
+        nothing was dropped."""
+        self._shutting_down = True
+        idle = self.drain(drain_deadline)
+        if not idle:
+            leftovers = [*self._queued, *list(self._running.values()),
+                         *self._preempted]
+            logger.warning(
+                f"serving: shutdown drain deadline ({drain_deadline}s) "
+                f"expired with {len(leftovers)} request(s) pending — "
+                "failing them with reason 'shutdown'")
+            for req in leftovers:
+                self._fail(req, "shutdown")
+            self.metrics.export()
+        return idle
 
     def drain(self, deadline: float) -> bool:
         """Async-friendly bounded drain: step until idle or ``deadline``

@@ -118,6 +118,58 @@ def test_host_api():
     dist.barrier()
 
 
+# ------------------------------------------------------------------ #
+# barrier(timeout=) + the uninitialized-collective guard (no shard_map
+# dependence: these run on the jax-0.4.37 host too)
+# ------------------------------------------------------------------ #
+def test_barrier_timeout_raises_instead_of_deadlocking(monkeypatch):
+    import time
+
+    from deepspeed_tpu.comm import comm as comm_mod
+
+    # a peer that never arrives: the underlying sync blocks "forever"
+    monkeypatch.setattr(comm_mod, "_sync_global",
+                        lambda tag: time.sleep(30))
+    t0 = time.monotonic()
+    with pytest.raises(dist.CommTimeoutError, match="timed out"):
+        dist.barrier(timeout=0.2, tag="test.barrier")
+    assert time.monotonic() - t0 < 5.0       # raised promptly, no deadlock
+    with pytest.raises(ValueError, match="timeout must be > 0"):
+        dist.barrier(timeout=0.0)
+
+
+def test_barrier_timeout_passes_when_sync_completes(monkeypatch):
+    from deepspeed_tpu.comm import comm as comm_mod
+
+    calls = []
+    monkeypatch.setattr(comm_mod, "_sync_global", calls.append)
+    dist.barrier(timeout=5.0, tag="test.fast")
+    assert calls == ["test.fast"]
+
+
+def test_barrier_timeout_propagates_sync_errors(monkeypatch):
+    from deepspeed_tpu.comm import comm as comm_mod
+
+    def _boom(tag):
+        raise RuntimeError("peer went away")
+
+    monkeypatch.setattr(comm_mod, "_sync_global", _boom)
+    with pytest.raises(RuntimeError, match="peer went away"):
+        dist.barrier(timeout=5.0)
+
+
+def test_collective_outside_mesh_names_init_distributed():
+    """An eager collective (no mesh axes bound) must fail with an
+    actionable error naming init_distributed, not jax's bare
+    ``NameError: unbound axis name``."""
+    with pytest.raises(RuntimeError, match="init_distributed"):
+        dist.all_reduce(jnp.arange(4.0), group="data")
+    with pytest.raises(RuntimeError, match="no mesh axis"):
+        dist.all_gather(jnp.arange(4.0), group="data")
+    with pytest.raises(RuntimeError, match="shard_map"):
+        dist.reduce_scatter(jnp.arange(8.0), group="data")
+
+
 def test_slurm_first_host_compressed_nodelists():
     """mpi_discovery must resolve rank-0's host from compressed SLURM
     nodelists (ADVICE r3: node[01-04] is the common production form)."""

@@ -74,7 +74,9 @@ def test_multinode_cmds_contain_rendezvous():
     info = {"w0": [0], "w1": [0]}
     cmds = ds_runner.build_multinode_cmds(args, info, "w0")
     assert len(cmds) == 2
-    assert cmds[0][0] == "ssh" and cmds[0][1] == "w0"
+    # -tt: local ssh-client death must hang up (and thus tear down) the
+    # remote launch instead of orphaning it
+    assert cmds[0][:2] == ["ssh", "-tt"] and cmds[0][2] == "w0"
     assert "--node_rank=1" in cmds[1][-1]
     assert "--master_addr=w0" in cmds[0][-1]
     assert "train.py" in cmds[0][-1]
@@ -225,7 +227,8 @@ def test_elastic_restart_loop(tmp_path):
         "sys.exit(0 if n >= 2 else 1)\n")
     rc = ds_runner.main([
         "--hostfile", "/nonexistent", "--num_gpus", "1",
-        "--elastic_training", "--max_restarts", "3", str(script)])
+        "--elastic_training", "--max_restarts", "3",
+        "--restart_backoff_s", "0.01", str(script)])
     assert rc == 0
     assert marker.read_text() == "3"  # two failures + one success
 
@@ -235,8 +238,176 @@ def test_elastic_restart_gives_up(tmp_path):
     script.write_text("import sys; sys.exit(5)\n")
     rc = ds_runner.main([
         "--hostfile", "/nonexistent", "--num_gpus", "1",
-        "--elastic_training", "--max_restarts", "1", str(script)])
+        "--elastic_training", "--max_restarts", "1",
+        "--restart_backoff_s", "0.01", str(script)])
     assert rc == 5
+
+
+def test_elastic_restart_emits_resilience_events(tmp_path):
+    """The elastic loop runs the supervisor's backoff/budget policy and
+    emits structured resilience/restart_* events."""
+    from deepspeed_tpu.resilience import ResilienceMetrics
+
+    marker = tmp_path / "attempts"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import pathlib, sys\n"
+        f"p = pathlib.Path(r'{marker}')\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "sys.exit(0 if n >= 1 else 1)\n")
+    metrics = ResilienceMetrics()
+    rc = ds_runner.main([
+        "--hostfile", "/nonexistent", "--num_gpus", "1",
+        "--elastic_training", "--max_restarts", "2",
+        "--restart_backoff_s", "0.01", str(script)], metrics=metrics)
+    assert rc == 0
+    assert metrics.restarts == 1 and metrics.restart_crash == 1
+    assert metrics.last_restart_backoff_s > 0
+    snap = metrics.snapshot()
+    assert snap["restart_total"] == 1.0
+    assert snap["world_size"] == 1.0          # 1 process before and after
+
+
+def test_elastic_stops_on_operator_signal(tmp_path):
+    """A SIGTERM delivered to the RUNNER must end the elastic loop (no
+    respawning against a Ctrl-C / scheduler stop).  The operator-stop
+    decision keys off wait_all's signal channel, not the numeric exit
+    code — a worker group that merely exits 143 is a crash to restart."""
+    import os
+    import signal as _signal
+    import threading
+
+    from deepspeed_tpu.resilience import ResilienceMetrics
+
+    script = tmp_path / "sleeper.py"
+    script.write_text("import time; time.sleep(60)\n")
+    metrics = ResilienceMetrics()
+    threading.Timer(1.0, lambda: os.kill(os.getpid(),
+                                         _signal.SIGTERM)).start()
+    rc = ds_runner.main([
+        "--hostfile", "/nonexistent", "--num_gpus", "1",
+        "--elastic_training", "--max_restarts", "3",
+        "--restart_backoff_s", "0.01", str(script)], metrics=metrics)
+    assert rc == 128 + _signal.SIGTERM
+    assert metrics.restarts == 0              # no relaunch happened
+
+
+def test_elastic_restarts_signal_coded_worker_exit(tmp_path):
+    """A worker group whose exit code merely LOOKS like a signal (143 —
+    e.g. a preempted remote node) is a crash the elastic loop must
+    restart, not an operator stop."""
+    marker = tmp_path / "attempts"
+    script = tmp_path / "preempted.py"
+    script.write_text(
+        "import pathlib, sys\n"
+        f"p = pathlib.Path(r'{marker}')\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "sys.exit(0 if n >= 1 else 143)\n")
+    rc = ds_runner.main([
+        "--hostfile", "/nonexistent", "--num_gpus", "1",
+        "--elastic_training", "--max_restarts", "2",
+        "--restart_backoff_s", "0.01", str(script)])
+    assert rc == 0
+    assert marker.read_text() == "2"          # restarted once, then clean
+
+
+def test_elastic_budget_is_sliding_window(tmp_path):
+    """--max_restarts counts restarts within --restart_window_s, not over
+    the job's lifetime: with a tiny window the budget regenerates and a
+    thrice-failing script still completes under max_restarts=1... per
+    window."""
+    marker = tmp_path / "attempts"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import pathlib, sys, time\n"
+        f"p = pathlib.Path(r'{marker}')\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "time.sleep(0.3)\n"                    # outlives the budget window
+        "sys.exit(0 if n >= 2 else 1)\n")
+    rc = ds_runner.main([
+        "--hostfile", "/nonexistent", "--num_gpus", "1",
+        "--elastic_training", "--max_restarts", "1",
+        "--restart_backoff_s", "0.01", "--restart_window_s", "0.2",
+        str(script)])
+    assert rc == 0
+    assert marker.read_text() == "3"
+
+
+# ------------------------------------------------------------------ #
+# Concurrent node-launcher supervision (wait_all)
+# ------------------------------------------------------------------ #
+def _popen_sleeper(seconds=60.0):
+    return subprocess.Popen(
+        [sys.executable, "-c", f"import time; time.sleep({seconds})"],
+        start_new_session=True)
+
+
+def test_wait_all_terminates_siblings_on_first_failure():
+    """One node launcher failing must not leave the runner serially
+    wait()ing on a hung sibling: the sibling is torn down and the first
+    failure's code comes back promptly."""
+    import time as _time
+
+    bad = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(3)"],
+                           start_new_session=True)
+    hung = _popen_sleeper(60)
+    t0 = _time.monotonic()
+    rc = ds_runner.wait_all([bad, hung], poll_s=0.02, term_grace_s=1.0)
+    assert rc == 3
+    assert _time.monotonic() - t0 < 10.0
+    assert hung.poll() is not None            # sibling did not survive
+
+
+def test_wait_all_escalates_sigkill():
+    stubborn = subprocess.Popen(
+        [sys.executable, "-c",
+         "import signal, time; signal.signal(signal.SIGTERM, "
+         "signal.SIG_IGN); time.sleep(60)"],
+        start_new_session=True)
+    import time as _time
+
+    _time.sleep(0.2)                          # let it install the handler
+    bad = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(2)"],
+                           start_new_session=True)
+    rc = ds_runner.wait_all([bad, stubborn], poll_s=0.02, term_grace_s=0.3)
+    assert rc == 2
+    assert stubborn.poll() is not None        # SIGKILL got it
+
+
+def test_wait_all_spawn_failure_tears_down_started_launchers():
+    """A fork/exec failure mid-spawn must not orphan the launchers that
+    already started (they live in their own sessions, unreachable from
+    the terminal)."""
+    import time as _time
+
+    sleeper_cmd = [sys.executable, "-c", "import time; time.sleep(60)"]
+    # the sleeper spawns fine; the bogus binary raises FileNotFoundError
+    rc = ds_runner.wait_all(spawn=[sleeper_cmd, ["/nonexistent-binary-xyz"]],
+                            poll_s=0.02, term_grace_s=1.0)
+    assert rc != 0
+    # nothing survives: the started sleeper was reaped
+    _time.sleep(0.2)
+    assert not subprocess.run(
+        ["pgrep", "-f", "time.sleep[(]60[)]"],
+        capture_output=True).stdout.strip()
+
+
+def test_wait_all_forwards_signals_to_child_groups():
+    """SIGTERM to the runner reaches every child process group (Ctrl-C
+    never orphans workers) and the runner exits 128+signum."""
+    import os
+    import signal as _signal
+    import threading
+
+    child = _popen_sleeper(60)
+    threading.Timer(0.2, lambda: os.kill(os.getpid(),
+                                         _signal.SIGTERM)).start()
+    rc = ds_runner.wait_all([child], poll_s=0.02, term_grace_s=1.0)
+    assert rc == 128 + _signal.SIGTERM
+    assert child.poll() is not None
 
 
 # ------------------------------------------------------------------ #

@@ -94,7 +94,12 @@ def test_atomic_save_layout_and_manifest(tmp_path):
         assert not [f for f in os.listdir(tag_dir) if f.endswith(".crc.json")]
 
 
-@pytest.mark.parametrize("point", sorted(chaos.FAULT_POINTS))
+# only the checkpoint-path fault points live on the save path; the
+# supervision points (worker_crash / worker_hang / heartbeat_stall) fire
+# in the train loop and heartbeat and are covered by test_supervisor.py
+@pytest.mark.parametrize("point", ["slow_io", "crash_after_shard_write",
+                                   "corrupt_shard_bytes",
+                                   "fail_latest_publish"])
 def test_save_crash_at_every_fault_point_keeps_latest_verified(
         tmp_path, point):
     """The crash-recovery invariant: a save dying at ANY fault point

@@ -633,6 +633,47 @@ def test_monitor_int_steps_unchanged(tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# Graceful shutdown: stop admission, drain, fail leftovers as "shutdown"
+# --------------------------------------------------------------------- #
+def test_shutdown_drain_completes(params):
+    eng = _engine(params)
+    sched = ContinuousBatchScheduler(eng)
+    reqs = [sched.submit([1, 2, 3], sampling=SamplingParams(max_new_tokens=4)),
+            sched.submit([4, 5], sampling=SamplingParams(max_new_tokens=4))]
+    sched.step()                                  # in-flight work exists
+    assert sched.shutdown(drain_deadline=60.0) is True
+    for r in reqs:
+        assert r.state is RequestState.FINISHED
+        assert len(r.generated) == 4              # nothing truncated
+    assert sched.metrics.shutdown_failed == 0
+    assert sched.metrics.snapshot()["shutdown_failed"] == 0.0
+    # admission is closed for good
+    with pytest.raises(RuntimeError, match="shutting down"):
+        sched.submit([7, 8])
+    assert sched.metrics.rejected == 1
+
+
+def test_shutdown_deadline_expires_fails_pending(params):
+    eng = _engine(params)
+    sched = ContinuousBatchScheduler(eng)
+    running = sched.submit([1, 2, 3],
+                           sampling=SamplingParams(max_new_tokens=8))
+    queued = sched.submit([4, 5, 6],
+                          sampling=SamplingParams(max_new_tokens=8))
+    sched.step()
+    assert sched.shutdown(drain_deadline=0.0) is False
+    for r in (running, queued):
+        assert r.state is RequestState.FAILED
+        assert r.finish_reason == "shutdown"
+    assert sched.metrics.shutdown_failed == 2
+    assert sched.num_pending == 0
+    # device KV fully released: a new scheduler could start on this engine
+    sm = eng.state_manager
+    assert sm.n_tracked_sequences == 0
+    assert sm.free_blocks == sm.allocator.num_blocks - 1
+
+
+# --------------------------------------------------------------------- #
 # The tier-1 smoke (tools/serving_smoke.py)
 # --------------------------------------------------------------------- #
 def test_serving_smoke_tool():

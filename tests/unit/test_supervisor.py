@@ -1,0 +1,486 @@
+"""Job supervision matrix: heartbeat protocol units, restart-policy
+pieces (backoff / sliding-window budget / blacklist), and the
+``JobSupervisor`` detect → kill → resize → resume loop over real
+subprocess workers — clean exit, crash restart, hang detection within 2x
+the heartbeat interval, SIGTERM→SIGKILL escalation, backoff growth +
+budget exhaustion, host blacklist → elastic downsize, and stack-dump
+capture.  Workers are tiny stdlib-only scripts (no jax import) so the
+whole matrix runs in seconds; the full training-loop integration runs in
+``tools/supervisor_smoke.py`` (wired in at the bottom behind a hard
+subprocess timeout so a supervisor bug can never hang CI).
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+from deepspeed_tpu.resilience import (BackoffPolicy, Heartbeat,
+                                      HostBlacklist, JobSupervisor,
+                                      ResilientTrainLoop,
+                                      RestartBudget, WorkerSpec, chaos,
+                                      read_heartbeat)
+from deepspeed_tpu.resilience.supervisor import WorkerHandle
+from deepspeed_tpu.resilience.chaos import ChaosInjectedError
+
+_TOOL = pathlib.Path(__file__).resolve().parents[2] / "tools" / \
+    "supervisor_smoke.py"
+
+
+#: supervisors created through _supervisor(), stopped at teardown even
+#: when an assertion fails mid-test — a leaked monitor thread + workers
+#: would poison every test after it
+_LIVE_SUPERVISORS = []
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    from deepspeed_tpu.resilience import heartbeat as hb_mod
+
+    # the launcher's elastic tests export a node range for their children;
+    # it must not constrain this file's elastic sizing
+    monkeypatch.delenv("DS_ELASTIC_NODE_RANGE", raising=False)
+    chaos.disarm()
+    yield
+    for sup in _LIVE_SUPERVISORS:
+        try:
+            sup.stop()
+        except Exception:
+            pass
+    _LIVE_SUPERVISORS.clear()
+    chaos.disarm()
+    # in-process Heartbeats register as the process-wide active ticker;
+    # don't leak them (and their tmp paths) into later tests
+    hb_mod._active = None
+
+
+# --------------------------------------------------------------------- #
+# Heartbeat protocol
+# --------------------------------------------------------------------- #
+def test_heartbeat_beat_and_read(tmp_path):
+    path = str(tmp_path / "hb")
+    hb = Heartbeat(path, interval_s=0.2)     # constructor beats once
+    info = read_heartbeat(path)
+    assert info.exists and info.age_s < 5.0
+    assert info.pid == os.getpid() and info.step is None
+    time.sleep(0.06)                          # clear the interval/4 throttle
+    assert hb.beat(step=7)
+    assert read_heartbeat(path).step == 7
+
+
+def test_heartbeat_throttles_hot_loop(tmp_path):
+    hb = Heartbeat(str(tmp_path / "hb"), interval_s=10.0)
+    # immediately after the constructor's beat the throttle swallows these
+    assert not hb.beat(1)
+    assert not hb.beat(2)
+    assert hb.beat(3, force=True)
+
+
+def test_heartbeat_chaos_stall_drops_beats(tmp_path):
+    path = str(tmp_path / "hb")
+    hb = Heartbeat(path, interval_s=0.01)
+    time.sleep(0.01)
+    assert hb.beat(1)
+    chaos.arm("heartbeat_stall", count=0)
+    time.sleep(0.01)
+    assert not hb.beat(2)
+    assert read_heartbeat(path).step == 1    # file untouched by the stall
+
+
+def test_heartbeat_from_env(tmp_path, monkeypatch):
+    from deepspeed_tpu.resilience import heartbeat as hb_mod
+
+    assert Heartbeat.from_env() is None or "DS_HEARTBEAT_FILE" in os.environ
+    path = str(tmp_path / "hb")
+    dump = str(tmp_path / "dump.txt")
+    monkeypatch.setenv(hb_mod.ENV_FILE, path)
+    monkeypatch.setenv(hb_mod.ENV_INTERVAL, "0.25")
+    monkeypatch.setenv(hb_mod.ENV_DUMP, dump)
+    hb = Heartbeat.from_env()
+    assert hb is not None and hb.interval_s == 0.25
+    assert read_heartbeat(path).exists
+    assert os.path.exists(dump)              # faulthandler target installed
+
+
+def test_read_heartbeat_missing_and_torn(tmp_path):
+    missing = read_heartbeat(str(tmp_path / "nope"))
+    assert not missing.exists and missing.age_s is None
+    # a torn payload still counts as a beat (mtime is the liveness signal)
+    torn = tmp_path / "torn"
+    torn.write_text("{not json")
+    info = read_heartbeat(str(torn))
+    assert info.exists and info.age_s is not None and info.step is None
+
+
+def test_train_loop_ticks_heartbeat(tmp_path):
+    class _Eng:
+        global_steps = 0
+
+        def train_micro_batch(self, batch):
+            return 0.1
+
+        def load_checkpoint(self, d, **kw):
+            return None, {}
+
+    path = str(tmp_path / "hb")
+    loop = ResilientTrainLoop(_Eng(), lambda step: step, str(tmp_path / "ck"),
+                              save_interval=100,
+                              heartbeat=Heartbeat(path, interval_s=0.01))
+    time.sleep(0.01)
+    loop.run(3)
+    assert read_heartbeat(path).step in (0, 1, 2)
+
+
+def test_worker_crash_fault_point_fires_in_loop(tmp_path):
+    class _Eng:
+        global_steps = 0
+
+        def train_micro_batch(self, batch):
+            return 0.1
+
+        def load_checkpoint(self, d, **kw):
+            return None, {}
+
+    loop = ResilientTrainLoop(_Eng(), lambda step: step, str(tmp_path),
+                              save_interval=100)
+    chaos.arm("worker_crash", action="raise", after=1)
+    with pytest.raises(ChaosInjectedError):
+        loop.run(5)
+
+
+# --------------------------------------------------------------------- #
+# Policy pieces
+# --------------------------------------------------------------------- #
+def test_backoff_growth_cap_and_jitter():
+    bp = BackoffPolicy(base_s=1.0, factor=2.0, max_s=5.0, jitter=0.0)
+    assert [bp.delay(i) for i in range(5)] == [1.0, 2.0, 4.0, 5.0, 5.0]
+    jittered = BackoffPolicy(base_s=1.0, factor=2.0, max_s=60.0, jitter=0.5)
+    for i in range(4):
+        assert 2.0 ** i <= jittered.delay(i) <= 2.0 ** i * 1.5
+    with pytest.raises(ValueError):
+        BackoffPolicy(base_s=10.0, max_s=1.0)
+
+
+def test_restart_budget_sliding_window():
+    b = RestartBudget(max_restarts=2, window_s=10.0)
+    assert not b.exhausted(0.0)
+    b.record(0.0)
+    b.record(1.0)
+    assert b.exhausted(2.0)          # 2 restarts inside the window
+    assert not b.exhausted(10.5)     # the first slid out: budget earned back
+    b.record(10.5)
+    assert b.in_window(10.6) == 2    # 1.0 and 10.5 still inside
+    assert b.exhausted(10.6)
+    assert not b.exhausted(25.0)     # everything slid out
+
+
+def test_host_blacklist_consecutive_failures_only():
+    bl = HostBlacklist(threshold=2)
+    assert not bl.record_failure("h")
+    bl.record_success("h")           # healthy run resets the count
+    assert not bl.record_failure("h")
+    assert bl.record_failure("h")    # 2 consecutive -> blacklisted
+    assert bl.is_blacklisted("h") and bl.hosts == {"h"}
+    assert not bl.record_failure("h")  # already blacklisted: no re-trigger
+
+
+# --------------------------------------------------------------------- #
+# JobSupervisor over real subprocess workers (stdlib-only: fast)
+# --------------------------------------------------------------------- #
+_WORKER = r"""
+import json, os, signal, sys, time
+
+HB = os.environ["DS_HEARTBEAT_FILE"]
+
+def beat(step):
+    tmp = HB + ".t"
+    with open(tmp, "w") as f:
+        json.dump({"pid": os.getpid(), "step": step, "time": time.time()}, f)
+    os.replace(tmp, HB)
+
+mode = sys.argv[1]
+if mode == "ok":                     # beat briefly, exit clean
+    for i in range(5):
+        beat(i); time.sleep(0.02)
+    sys.exit(0)
+elif mode == "slow":                 # keep beating until terminated
+    i = 0
+    while True:
+        beat(i); time.sleep(0.02); i += 1
+elif mode.startswith("crash"):       # beat, then die nonzero
+    for i in range(3):
+        beat(i); time.sleep(0.02)
+    sys.exit(int(mode.split("_")[1]))
+elif mode == "stall":                # alive but silent: the hang signature
+    for i in range(3):
+        beat(i); time.sleep(0.02)
+    time.sleep(60)
+elif mode == "stubborn":             # stalls AND ignores SIGTERM (and the
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)   # dump request, which
+    signal.signal(signal.SIGUSR1, signal.SIG_IGN)   # would otherwise kill)
+    for i in range(3):
+        beat(i); time.sleep(0.02)
+    time.sleep(60)
+elif mode == "dump":                 # stall with a faulthandler installed
+    import faulthandler
+    faulthandler.register(signal.SIGUSR1,
+                          file=open(os.environ["DS_STACKDUMP_FILE"], "w"),
+                          all_threads=True)
+    for i in range(3):
+        beat(i); time.sleep(0.02)
+    time.sleep(60)
+else:
+    sys.exit(99)
+"""
+
+
+@pytest.fixture
+def worker_script(tmp_path):
+    path = tmp_path / "worker.py"
+    path.write_text(_WORKER)
+    return str(path)
+
+
+def _supervisor(worker_script, tmp_path, modes_by_attempt, hosts=("h0", "h1"),
+                **kwargs):
+    """modes_by_attempt: {attempt: {host: mode}}; hosts missing from an
+    attempt's dict run "ok", attempts past the last key reuse it."""
+
+    def spec_fn(current_hosts, attempt):
+        key = attempt if attempt in modes_by_attempt \
+            else max(k for k in modes_by_attempt if k <= attempt)
+        modes = modes_by_attempt[key]
+        return [WorkerSpec(host=h,
+                           cmd=[sys.executable, worker_script,
+                                modes.get(h, "ok")])
+                for h in current_hosts]
+
+    defaults = dict(run_dir=str(tmp_path / "run"),
+                    heartbeat_interval_s=0.2,
+                    hang_timeout_s=1.0,
+                    poll_s=0.02,
+                    term_grace_s=1.0,
+                    dump_grace_s=0.5,
+                    backoff=BackoffPolicy(base_s=0.02, jitter=0.0),
+                    max_restarts=3,
+                    blacklist_after=3)
+    defaults.update(kwargs)
+    sup = JobSupervisor(spec_fn, list(hosts), **defaults)
+    _LIVE_SUPERVISORS.append(sup)
+    return sup
+
+
+def _events(sup, name):
+    return [e for e in sup.events if e["event"] == name]
+
+
+def test_clean_exit(worker_script, tmp_path):
+    sup = _supervisor(worker_script, tmp_path, {0: {}})
+    assert sup.run(timeout=30) == 0
+    assert sup.attempt == 0 and sup.metrics.restarts == 0
+    assert _events(sup, "clean_exit")
+
+
+def test_crash_detected_and_restarted(worker_script, tmp_path):
+    sup = _supervisor(worker_script, tmp_path,
+                      {0: {"h0": "crash_7", "h1": "slow"}, 1: {}})
+    assert sup.run(timeout=30) == 0
+    assert sup.metrics.restarts == 1 and sup.metrics.restart_crash == 1
+    crash = _events(sup, "crash_detected")[0]
+    assert crash["host"] == "h0" and crash["rc"] == 7
+    restart = _events(sup, "restart")[0]
+    assert restart["reason"] == "crash"
+    assert (restart["world_before"], restart["world_after"]) == (2, 2)
+    assert restart["backoff_s"] > 0
+
+
+def test_hang_detected_within_2x_heartbeat_interval(worker_script, tmp_path):
+    interval = 0.3
+    sup = _supervisor(worker_script, tmp_path,
+                      {0: {"h0": "stall", "h1": "slow"}, 1: {}},
+                      heartbeat_interval_s=interval,
+                      hang_timeout_s=1.5 * interval, poll_s=0.02)
+    assert sup.run(timeout=30) == 0
+    assert sup.metrics.restart_hang == 1 and sup.metrics.hangs == 1
+    hang = _events(sup, "hang_detected")[0]
+    assert hang["host"] == "h0"
+    assert hang["age_s"] <= 2 * interval, hang
+
+
+def test_sigterm_sigkill_escalation(worker_script, tmp_path):
+    # the stubborn worker ignores SIGTERM; max_restarts=0 -> one fault
+    # exhausts the budget, so the test ends right after the escalation
+    sup = _supervisor(worker_script, tmp_path, {0: {"h0": "stubborn"}},
+                      hosts=("h0",), hang_timeout_s=0.3, term_grace_s=0.3,
+                      max_restarts=0)
+    rc = sup.run(timeout=30)
+    assert rc == 1 and "budget exhausted" in sup.error
+    assert sup.metrics.escalations >= 1
+    esc = _events(sup, "escalate_kill")[0]
+    assert esc["host"] == "h0"
+    # nothing survives the escalation
+    assert all(h.proc.poll() is not None for h in sup.handles)
+
+
+def test_backoff_growth_and_budget_exhaustion(worker_script, tmp_path):
+    sup = _supervisor(worker_script, tmp_path, {0: {"h0": "crash_5"}},
+                      hosts=("h0",), max_restarts=2,
+                      backoff=BackoffPolicy(base_s=0.02, factor=2.0,
+                                            jitter=0.0))
+    rc = sup.run(timeout=30)
+    assert rc == 5                       # the crashing worker's exit code
+    assert sup.metrics.restarts == 2
+    delays = [e["backoff_s"] for e in _events(sup, "restart")]
+    assert delays == [0.02, 0.04]        # exponential growth in-window
+    assert _events(sup, "give_up")
+    assert "budget exhausted" in sup.error
+
+
+def test_host_blacklist_and_elastic_downsize(worker_script, tmp_path):
+    # h2 fails instantly; blacklist_after=1 removes it, and the elastic
+    # batch algebra (micro=1, ceiling 12 -> valid counts {1,2,3,4,6,12})
+    # admits the shrunken 2-host world
+    elastic = {"elasticity": {"enabled": True, "max_train_batch_size": 12,
+                              "micro_batch_sizes": [1], "version": 0.1}}
+    sup = _supervisor(worker_script, tmp_path,
+                      {0: {"h0": "slow", "h1": "slow", "h2": "crash_3"},
+                       1: {}},
+                      hosts=("h0", "h1", "h2"), blacklist_after=1,
+                      elastic_config=elastic)
+    assert sup.run(timeout=30) == 0
+    assert sup.blacklist.hosts == {"h2"}
+    assert sup.metrics.blacklisted_hosts == 1
+    restart = _events(sup, "restart")[0]
+    assert (restart["world_before"], restart["world_after"]) == (3, 2)
+    assert sup.hosts == ["h0", "h1"]
+    assert sup.metrics.world_size == 2
+
+
+def test_sibling_crash_counts_against_its_host(worker_script, tmp_path):
+    """When two workers crash in the same wave, the one detected second
+    must not receive the torn-down-by-us success credit — its host failed
+    on its own."""
+    sup = _supervisor(worker_script, tmp_path, {0: {}}, hosts=("h0", "h1"),
+                      blacklist_after=1, max_restarts=0)
+    p0 = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(3)"])
+    p1 = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(5)"])
+    p0.wait()
+    p1.wait()
+    h0 = WorkerHandle(WorkerSpec("h0", []), p0,
+                      str(tmp_path / "hb0"), str(tmp_path / "d0"))
+    h1 = WorkerHandle(WorkerSpec("h1", []), p1,
+                      str(tmp_path / "hb1"), str(tmp_path / "d1"))
+    sup.handles = [h0, h1]
+    faults = iter([("crash", h0, 3, None)])
+    sup._watch = lambda: next(faults, None)
+    sup._supervise_inner()          # budget 0 -> gives up after accounting
+    assert sup.blacklist.hosts == {"h0", "h1"}
+
+
+def test_healthy_sibling_on_culprit_host_does_not_erase_failure(
+        worker_script, tmp_path):
+    """slots_per_host > 1: a healthy sibling worker on the culprit's OWN
+    host must not reset that host's consecutive-failure count."""
+    sup = _supervisor(worker_script, tmp_path, {0: {}}, hosts=("h0",),
+                      blacklist_after=2, max_restarts=0)
+    dead = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(3)"])
+    dead.wait()
+    alive = subprocess.Popen([sys.executable, "-c",
+                              "import time; time.sleep(30)"],
+                             start_new_session=True)
+    culprit = WorkerHandle(WorkerSpec("h0", []), dead,
+                           str(tmp_path / "hb0"), str(tmp_path / "d0"))
+    sibling = WorkerHandle(WorkerSpec("h0", []), alive,
+                           str(tmp_path / "hb1"), str(tmp_path / "d1"))
+    sup.handles = [culprit, sibling]
+    faults = iter([("crash", culprit, 3, None)])
+    sup._watch = lambda: next(faults, None)
+    sup._supervise_inner()
+    # the wave's failure must have survived the sibling's success credit:
+    # one more failure crosses the threshold=2
+    assert sup.blacklist.record_failure("h0") is True
+
+
+def test_sized_world_supports_v02_elastic_config(worker_script, tmp_path):
+    """v0.2 (node-granular) elasticity configs must size the world from
+    the candidate host count, not from a stale WORLD_SIZE env."""
+    elastic = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                              "micro_batch_sizes": [1, 2],
+                              "num_gpus_per_node": 1, "version": 0.2}}
+    sup = _supervisor(worker_script, tmp_path, {0: {}},
+                      hosts=("h0", "h1", "h2"), elastic_config=elastic)
+    world = sup._sized_world(["h0", "h1", "h2"])
+    assert world is not None and 1 <= len(world) <= 3
+
+
+def test_same_host_specs_get_distinct_heartbeat_files(worker_script,
+                                                      tmp_path):
+    """slots_per_host > 1: two workers on one host label must not share a
+    heartbeat file (one's beats would mask the other's hang)."""
+
+    def spec_fn(hosts, attempt):
+        return [WorkerSpec(host="h0",
+                           cmd=[sys.executable, worker_script, "ok"])
+                for _ in range(2)]
+
+    sup = JobSupervisor(spec_fn, ["h0"], run_dir=str(tmp_path / "run"),
+                        heartbeat_interval_s=0.2, poll_s=0.02,
+                        backoff=BackoffPolicy(base_s=0.02, jitter=0.0))
+    _LIVE_SUPERVISORS.append(sup)
+    assert sup.run(timeout=30) == 0
+    files = {h.heartbeat_file for h in sup.handles}
+    assert len(files) == 2
+
+
+def test_stack_dump_captured_before_kill(worker_script, tmp_path):
+    sup = _supervisor(worker_script, tmp_path,
+                      {0: {"h0": "dump", "h1": "slow"}, 1: {}},
+                      hang_timeout_s=0.4)
+    assert sup.run(timeout=30) == 0
+    dumps = sup.dumps.get("h0", [])
+    assert dumps, f"no dump captured: {sup.events}"
+    assert "File" in dumps[0]            # a real traceback, not noise
+    assert _events(sup, "dump_captured")
+
+
+def test_supervisor_rejects_bad_config(worker_script, tmp_path):
+    with pytest.raises(ValueError, match="at least one host"):
+        _supervisor(worker_script, tmp_path, {0: {}}, hosts=())
+    with pytest.raises(ValueError, match="duplicate"):
+        _supervisor(worker_script, tmp_path, {0: {}}, hosts=("h", "h"))
+
+
+def test_stop_tears_down_workers(worker_script, tmp_path):
+    sup = _supervisor(worker_script, tmp_path, {0: {"h0": "slow",
+                                                    "h1": "slow"}})
+    sup.start()
+    time.sleep(0.3)
+    assert all(h.proc.poll() is None for h in sup.handles)
+    sup.stop()
+    assert all(h.proc.poll() is not None for h in sup.handles)
+    assert sup.returncode == 0
+
+
+# --------------------------------------------------------------------- #
+# The tier-1 smoke (tools/supervisor_smoke.py): SIGKILL + heartbeat_stall
+# end-to-end with MiniEngine workers, behind a HARD timeout so a
+# supervisor bug can never hang CI.
+# --------------------------------------------------------------------- #
+def test_supervisor_smoke_tool(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, str(_TOOL)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=280)
+    assert proc.returncode == 0, \
+        f"stdout:\n{proc.stdout[-3000:]}\nstderr:\n{proc.stderr[-3000:]}"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith('{"supervisor_smoke"')]
+    assert lines, proc.stdout[-2000:]
+    snap = json.loads(lines[-1])
+    assert snap["supervisor_smoke"] == "ok"
+    assert snap["crash_resume_step"] > 0
+    assert snap["hang_dump_chars"] > 0
