@@ -1,0 +1,22 @@
+"""dslint — static & runtime correctness tooling for the Pallas/jit
+stack (the role nvcc's build-time checking plays for the reference's
+CUDA tree; see ``tools/dslint.py`` for the CLI).
+
+Three passes:
+
+* :mod:`.pallas_lint` — kernel contract checker over every
+  ``pallas_call`` site (tiling, index-map bounds, output coverage,
+  VMEM budget) via the :mod:`.registry` of representative shapes;
+* :mod:`.jit_lint`    — AST lint for jit-unsafe and host-sync patterns;
+* :mod:`.trace_guard` — runtime guard proving warmed-up regions are
+  recompile- and transfer-free.
+"""
+
+from deepspeed_tpu.analysis.common import Baseline, Finding  # noqa: F401
+from deepspeed_tpu.analysis.registry import (  # noqa: F401
+    KERNEL_CASES, pallas_kernel_case)
+from deepspeed_tpu.analysis.trace_guard import (  # noqa: F401
+    TraceGuard, TraceGuardError)
+
+__all__ = ["Baseline", "Finding", "KERNEL_CASES", "pallas_kernel_case",
+           "TraceGuard", "TraceGuardError"]

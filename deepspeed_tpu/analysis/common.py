@@ -1,0 +1,108 @@
+"""dslint common plumbing: findings and the suppression baseline.
+
+A :class:`Finding` is one lint hit. Its ``fingerprint`` is deliberately
+line-number-free (rule, file, enclosing function, rule-specific detail)
+so the committed baseline survives unrelated edits to the same file —
+the reference stack gets this stability for free from nvcc's
+per-declaration diagnostics; here we hash the declaration context
+ourselves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional
+
+#: severity order for report sorting
+SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str              # e.g. "jit-wallclock", "pallas-tiling"
+    path: str              # repo-relative path
+    line: int              # 1-based; 0 when not tied to a source line
+    func: str              # enclosing function/kernel-case name ("" ok)
+    message: str
+    hint: str = ""
+    severity: str = "error"
+
+    @property
+    def fingerprint(self) -> str:
+        key = "|".join((self.rule, self.path, self.func, self.message))
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "func": self.func, "message": self.message,
+                "hint": self.hint, "severity": self.severity,
+                "fingerprint": self.fingerprint}
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        head = f"{loc}: [{self.rule}] {self.message}"
+        if self.func:
+            head += f" (in {self.func})"
+        return head + (f"\n    hint: {self.hint}" if self.hint else "")
+
+
+class Baseline:
+    """Committed suppression list: known findings keyed by fingerprint.
+
+    ``dslint`` exits nonzero only on findings NOT in the baseline, so a
+    pre-existing debt item doesn't block CI while any new one does — the
+    same ratchet contract as the serving/resilience smokes.
+    """
+
+    def __init__(self, suppressions: Optional[Dict[str, dict]] = None):
+        self.suppressions: Dict[str, dict] = dict(suppressions or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            data = json.load(f)
+        return cls(data.get("suppressions", {}))
+
+    def save(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"version": 1, "suppressions": self.suppressions},
+                      f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        return cls({f.fingerprint: {"rule": f.rule, "path": f.path,
+                                    "func": f.func, "message": f.message}
+                    for f in findings})
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        return finding.fingerprint in self.suppressions
+
+    def split(self, findings: Iterable[Finding]
+              ) -> "tuple[List[Finding], List[Finding]]":
+        """(new, baselined)."""
+        new: List[Finding] = []
+        old: List[Finding] = []
+        for f in findings:
+            (old if self.is_suppressed(f) else new).append(f)
+        return new, old
+
+
+def repo_root() -> str:
+    """Package checkout root (the directory holding ``deepspeed_tpu/``)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def relpath(path: str) -> str:
+    try:
+        return os.path.relpath(os.path.abspath(path), repo_root())
+    except ValueError:  # different drive (windows) — keep absolute
+        return path

@@ -1,0 +1,377 @@
+"""jit-safety AST lint ("dslint" pass 2).
+
+Flags patterns that are legal Python but wrong under ``jax.jit`` /
+``custom_vjp`` / Pallas kernel bodies, or that silently serialize the
+host against the device on hot paths. Pure ``ast`` — no imports of the
+linted modules, so a file with a hard dependency problem still lints.
+
+Rules (ids are stable; hints name the fix):
+
+* ``jit-wallclock``   — wall-clock reads (``time.time`` & friends,
+  ``datetime.now``) inside a jit-context function: they run once at
+  trace time and bake a constant into the program.
+* ``jit-nprandom``    — ``np.random``/``numpy.random`` calls inside a
+  jit context: same trace-time freeze; use ``jax.random`` with threaded
+  keys.
+* ``jit-global``      — ``global`` statements inside a jit context:
+  mutation happens at trace time only.
+* ``jit-tracer-is``   — ``is`` / ``is not`` between non-constant
+  operands inside a jit context: tracers are fresh objects per trace,
+  identity never means value equality.
+* ``step-host-sync``  — ``.item()``, any ``jax.device_get(...)`` (bare
+  or wrapped in ``bool``/``int``/``float``) inside step-shaped
+  functions: a blocking device round-trip on the hot path (the fp16
+  overflow fetch this lint was built to catch). ``np.asarray`` on a
+  traced value is the same sync but type-invisible to AST — the
+  runtime :class:`~deepspeed_tpu.analysis.trace_guard.TraceGuard`
+  (transfer guard) owns that form.
+* ``timing-no-block`` — a wall-clock duration bracket (``t1 - t0``
+  with both ends from ``time.time``/``time.perf_counter``) that is
+  non-monotonic (``time.time``) and/or never blocks on device results
+  in the same function — the latter measures dispatch, not compute.
+  ``time.monotonic`` brackets are exempt (arrival pacing/deadlines).
+* ``mutable-default`` — list/dict/set literals as parameter defaults.
+* ``pltpu-any``       — ``pltpu.ANY``: the TPU pallas module has no
+  ``ANY``; the memory-space sentinel is ``pl.ANY`` (the PR-1 regression
+  class — an AttributeError that only fires when the kernel path is
+  actually taken).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from deepspeed_tpu.analysis.common import Finding, relpath
+
+#: function names treated as hot "step" paths for step-host-sync
+STEP_NAMES = {"step", "train_batch", "tick", "_post_step_bookkeeping"}
+
+_WALLCLOCK_ATTRS = {("time", "time"), ("time", "perf_counter"),
+                    ("time", "monotonic"), ("time", "process_time"),
+                    ("datetime", "now"), ("datetime", "utcnow")}
+
+#: clocks whose duration brackets the timing rule inspects.
+#: time.monotonic is deliberately absent: the repo uses it for arrival
+#: pacing / deadlines (host-side control flow), not device timing.
+_BRACKET_CLOCKS = ("time.time", "time.perf_counter")
+
+
+def _walk_own_scope(fn_node: ast.AST):
+    """Yield ``fn_node``'s own statements WITHOUT descending into nested
+    function definitions — per-function checks would otherwise report a
+    nested function's defect once per enclosing scope, and a nested
+    helper's blocking call would wrongly vouch for the outer function."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_target(call: ast.Call) -> Optional[str]:
+    return _dotted(call.func)
+
+
+def _is_jax_jit(expr: ast.AST) -> bool:
+    d = _dotted(expr)
+    return d in ("jax.jit", "jit", "pjit", "jax.pjit")
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """jax.jit / jax.custom_vjp / functools.partial(jax.jit, ...)."""
+    if _is_jax_jit(dec) or _dotted(dec) in ("jax.custom_vjp",
+                                            "custom_vjp", "jax.custom_jvp"):
+        return True
+    if isinstance(dec, ast.Call):
+        target = _call_target(dec)
+        if target in ("functools.partial", "partial") and dec.args:
+            return _is_jit_decorator(dec.args[0])
+        return _is_jit_decorator(dec.func)
+    return False
+
+
+def _first_callable_names(expr: ast.AST) -> Set[str]:
+    """Function names referenced by ``expr`` (through partial())."""
+    names: Set[str] = set()
+    if isinstance(expr, ast.Name):
+        names.add(expr.id)
+    elif isinstance(expr, ast.Call):
+        target = _call_target(expr)
+        if target in ("functools.partial", "partial"):
+            for a in expr.args:
+                names |= _first_callable_names(a)
+    return names
+
+
+class _ContextCollector(ast.NodeVisitor):
+    """First pass: which function names are jit contexts in this module
+    (decorated, jax.jit(f)-referenced, pallas kernels, defvjp'd)."""
+
+    def __init__(self):
+        self.jit_names: Set[str] = set()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        if any(_is_jit_decorator(d) for d in node.decorator_list):
+            self.jit_names.add(node.name)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call):
+        target = _call_target(node)
+        if target and (_is_jax_jit(node.func)
+                       or target.endswith("custom_vjp")):
+            for a in node.args[:1]:
+                self.jit_names |= _first_callable_names(a)
+        elif target and target.endswith("pallas_call") and node.args:
+            self.jit_names |= _first_callable_names(node.args[0])
+        elif target and target.endswith(".defvjp"):
+            for a in node.args:
+                self.jit_names |= _first_callable_names(a)
+        self.generic_visit(node)
+
+
+class _RuleVisitor(ast.NodeVisitor):
+    def __init__(self, path: str, jit_names: Set[str]):
+        self.path = path
+        self.jit_names = jit_names
+        self.findings: List[Finding] = []
+        self._func_stack: List[Tuple[str, bool]] = []  # (name, jit_ctx)
+
+    # -- context plumbing --------------------------------------------- #
+    @property
+    def _func(self) -> str:
+        return self._func_stack[-1][0] if self._func_stack else "<module>"
+
+    @property
+    def _in_jit(self) -> bool:
+        return bool(self._func_stack) and self._func_stack[-1][1]
+
+    def _emit(self, rule: str, node: ast.AST, message: str, hint: str):
+        self.findings.append(Finding(
+            rule=rule, path=self.path,
+            line=getattr(node, "lineno", 0), func=self._func,
+            message=message, hint=hint))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        jit_ctx = (self._in_jit                      # nested in a jit fn
+                   or node.name in self.jit_names
+                   or any(_is_jit_decorator(d) for d in node.decorator_list)
+                   or node.name.endswith("_kernel"))
+        self._check_mutable_defaults(node)
+        self._func_stack.append((node.name, jit_ctx))
+        if node.name in STEP_NAMES or node.name.endswith("_step"):
+            self._check_step_sync(node)
+        self._check_timing_bracket(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    # -- rules --------------------------------------------------------- #
+    def visit_Call(self, node: ast.Call):
+        target = _call_target(node) or ""
+        if self._in_jit:
+            if tuple(target.rsplit(".", 2)[-2:]) in _WALLCLOCK_ATTRS:
+                self._emit(
+                    "jit-wallclock", node,
+                    f"wall-clock read {target}() inside jit context "
+                    f"'{self._func}' is evaluated once at trace time",
+                    "hoist the clock read out of the jitted function "
+                    "(trace-time constant), or thread it in as an "
+                    "argument")
+            if target.startswith(("np.random.", "numpy.random.")):
+                self._emit(
+                    "jit-nprandom", node,
+                    f"{target}() inside jit context '{self._func}' "
+                    "freezes one sample at trace time",
+                    "use jax.random with an explicitly threaded key")
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global):
+        if self._in_jit:
+            self._emit(
+                "jit-global", node,
+                f"global mutation of {', '.join(node.names)} inside jit "
+                f"context '{self._func}' happens at trace time only",
+                "return the new value / carry it through the function "
+                "arguments instead")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        if self._in_jit and any(isinstance(op, (ast.Is, ast.IsNot))
+                                for op in node.ops):
+            operands = [node.left, *node.comparators]
+            if not any(isinstance(o, ast.Constant) for o in operands):
+                self._emit(
+                    "jit-tracer-is", node,
+                    f"'is' comparison between non-constants inside jit "
+                    f"context '{self._func}' — tracers are fresh objects "
+                    "every trace",
+                    "compare values (==, jnp.array_equal) or compare "
+                    "against None/sentinel constants only")
+        self.generic_visit(node)
+
+    def _check_mutable_defaults(self, node: ast.FunctionDef):
+        for default in (*node.args.defaults, *node.args.kw_defaults):
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.findings.append(Finding(
+                    rule="mutable-default", path=self.path,
+                    line=default.lineno, func=node.name,
+                    message=f"mutable default argument in "
+                            f"'{node.name}' is shared across calls",
+                    hint="default to None and construct inside the body"))
+
+    def _check_step_sync(self, node: ast.FunctionDef):
+        # device_get calls already covered by a bool/int/float wrapper
+        # finding (avoid double-reporting the inner call)
+        wrapped_inner = set()
+        for sub in _walk_own_scope(node):
+            if isinstance(sub, ast.Call) and \
+                    (_call_target(sub) or "") in ("bool", "int", "float") \
+                    and sub.args and isinstance(sub.args[0], ast.Call):
+                wrapped_inner.add(id(sub.args[0]))
+        for sub in _walk_own_scope(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "item" and not sub.args:
+                self._emit(
+                    "step-host-sync", sub,
+                    f".item() in step function '{node.name}' blocks the "
+                    "host on the device every step",
+                    "keep the scalar on device; fetch only at reporting "
+                    "boundaries")
+            target = _call_target(sub) or ""
+            # any device_get — bare, or wrapped in bool/int/float —
+            # blocks the host; np.asarray on traced values is the same
+            # sync but is type-invisible to AST, so the runtime
+            # TraceGuard (transfer guard) owns that form
+            spelled = None
+            if target.endswith("device_get") and id(sub) not in \
+                    wrapped_inner:
+                spelled = f"{target}(...)"
+            elif target in ("bool", "int", "float") and sub.args and \
+                    isinstance(sub.args[0], ast.Call) and \
+                    (_call_target(sub.args[0]) or "").endswith(
+                        "device_get"):
+                spelled = f"{target}(jax.device_get(...))"
+            if spelled:
+                self._emit(
+                    "step-host-sync", sub,
+                    f"{spelled} in step function '{node.name}' is a "
+                    "blocking device sync on the hot path",
+                    "accumulate the flag on device and fetch at "
+                    "reporting boundaries only (see runtime/engine.py "
+                    "overflow accounting / _log_fp16_skips)")
+
+    def _check_timing_bracket(self, node: ast.FunctionDef):
+        timed_locals: Dict[str, str] = {}   # local name -> clock
+        blocks = False
+        for sub in _walk_own_scope(node):
+            if isinstance(sub, ast.Assign) and isinstance(sub.value,
+                                                          ast.Call):
+                clock = _call_target(sub.value) or ""
+                if clock in _BRACKET_CLOCKS:
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            timed_locals[t.id] = clock
+            if isinstance(sub, ast.Call):
+                target = _call_target(sub) or ""
+                if target.endswith(("block_until_ready", "device_get",
+                                    "_sync")):
+                    blocks = True
+
+        def _clock_of(e: ast.AST) -> Optional[str]:
+            if isinstance(e, ast.Call):
+                target = _call_target(e) or ""
+                return target if target in _BRACKET_CLOCKS else None
+            if isinstance(e, ast.Name):
+                return timed_locals.get(e.id)
+            return None
+
+        for sub in _walk_own_scope(node):
+            if not (isinstance(sub, ast.BinOp)
+                    and isinstance(sub.op, ast.Sub)):
+                continue
+            lc, rc = _clock_of(sub.left), _clock_of(sub.right)
+            if lc is None or rc is None:
+                continue
+            nonmono = "time.time" in (lc, rc)
+            if not nonmono and blocks:
+                continue  # perf_counter bracket that blocks on results
+            msg_parts = []
+            hint_parts = []
+            if nonmono:
+                msg_parts.append(f"duration measured with time.time() in "
+                                 f"'{node.name}' — non-monotonic wall "
+                                 "clock")
+                hint_parts.append("use time.perf_counter()")
+            if not blocks:
+                msg_parts.append(
+                    (f"timing bracket in '{node.name}': " if not nonmono
+                     else "") + "nothing blocks on device results "
+                    "(this times dispatch, not compute)")
+                hint_parts.append("jax.block_until_ready/device_get the "
+                                  "results before stopping the clock")
+            self.findings.append(Finding(
+                rule="timing-no-block", path=self.path,
+                line=sub.lineno, func=node.name,
+                message=", and ".join(msg_parts),
+                hint=" and ".join(hint_parts)))
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if node.attr == "ANY" and _dotted(node) == "pltpu.ANY":
+            self._emit(
+                "pltpu-any", node,
+                "pltpu.ANY does not exist — this AttributeError only "
+                "fires when the kernel path is taken on a real TPU",
+                "the memory-space sentinel is pl.ANY (regression class "
+                "fixed in PR 1)")
+        self.generic_visit(node)
+
+
+def lint_file(path: str) -> List[Finding]:
+    try:
+        src = open(path).read()
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(rule="syntax-error", path=relpath(path),
+                        line=e.lineno or 0, func="",
+                        message=f"file does not parse: {e.msg}")]
+    ctx = _ContextCollector()
+    ctx.visit(tree)
+    visitor = _RuleVisitor(relpath(path), ctx.jit_names)
+    visitor.visit(tree)
+    return visitor.findings
+
+
+def run_jit_lint(paths) -> List[Finding]:
+    """Lint every .py file under ``paths`` (files or directories)."""
+    findings: List[Finding] = []
+    for p in paths:
+        if os.path.isfile(p):
+            findings.extend(lint_file(p))
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", "build", ".git")]
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    findings.extend(lint_file(os.path.join(root, name)))
+    return findings

@@ -1,0 +1,562 @@
+"""Pallas kernel contract checker ("dslint" pass 1).
+
+The reference stack's CUDA kernels get nvcc's shape/type checking at
+build time; a bad ``BlockSpec`` here only surfaces at Mosaic compile
+time on a real TPU — which tier-1 CPU runs never reach, and where this
+host's XLA can fatally abort the whole process (PR-1 note). This pass
+recovers the build-time check WITHOUT compiling anything:
+
+* every kernel module registers representative invocations
+  (:mod:`deepspeed_tpu.analysis.registry`, same parameter grids as
+  ``tools/kernel_selftest.py``);
+* the case runs under a **capture context**: ``pl.pallas_call`` is
+  intercepted, the call's grid/BlockSpecs/out_shape/scratch and the
+  concrete operands are recorded, and zeros of ``out_shape`` are
+  returned so the surrounding (eagerly executed) code keeps flowing —
+  no kernel body runs, no Mosaic compile happens;
+* each captured call is validated against the TPU contracts:
+
+  - **tiling**: a block's minor dim must be lane-aligned (multiple of
+    128) or cover the array's minor dim exactly; the second-minor dim
+    must be sublane-aligned for its dtype (8 for 4-byte, 16 for 2-byte,
+    32 for 1-byte) or cover the dim;
+  - **index-map bounds**: every index map is abstractly evaluated over
+    the full grid (with the case's real scalar-prefetch operands) and
+    each returned block origin must lie inside the array;
+  - **output coverage**: the union of output block indices over the
+    grid must cover every output tile (an uncovered tile is returned
+    uninitialised — NaN-bait);
+  - **arity/shape**: operand count matches ``in_specs``; output block
+    shapes divide ``out_shape``;
+  - **VMEM budget**: double-buffered blocks + scratch must fit the
+    ~16 MiB VMEM (per-case override for kernels that manage their own
+    residency).
+
+Finally an AST sweep cross-checks that every ``pallas_call`` site in
+the package was actually reached by some registered case, so a new
+kernel cannot silently dodge the checker.
+"""
+
+from __future__ import annotations
+
+import ast
+import contextlib
+import functools
+import importlib
+import inspect
+import itertools
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.analysis.common import Finding, relpath, repo_root
+from deepspeed_tpu.analysis import registry
+
+#: sublane multiple by dtype itemsize (bytes): fp32 packs 8 rows per
+#: (8, 128) tile, bf16 16, int8/fp8 32
+_SUBLANE = {8: 8, 4: 8, 2: 16, 1: 32}
+
+_LANE = 128
+
+#: exhaustive index-map evaluation cap; representative shapes stay far
+#: below this, and a case that exceeds it gets a finding instead of a
+#: silent partial check
+_MAX_GRID_POINTS = 65536
+
+
+class CapturedCall:
+    """One intercepted ``pallas_call`` with everything the checks need."""
+
+    def __init__(self, *, kernel_name: str, caller_path: str,
+                 caller_func: str, caller_line: int, grid: Tuple[int, ...],
+                 in_specs: Sequence[Any], out_specs: Sequence[Any],
+                 out_shapes: Sequence[Any], scratch_shapes: Sequence[Any],
+                 num_scalar_prefetch: int, operands: Sequence[Any],
+                 prefetch: Sequence[np.ndarray]):
+        self.kernel_name = kernel_name
+        self.caller_path = caller_path
+        self.caller_func = caller_func
+        self.caller_line = caller_line
+        self.grid = grid
+        self.in_specs = list(in_specs)
+        self.out_specs = list(out_specs)
+        self.out_shapes = list(out_shapes)
+        self.scratch_shapes = list(scratch_shapes)
+        self.num_scalar_prefetch = num_scalar_prefetch
+        self.operands = list(operands)          # ShapeDtype-likes
+        self.prefetch = list(prefetch)          # concrete numpy arrays
+
+    def where(self) -> str:
+        return f"{self.caller_path}:{self.caller_func}:{self.kernel_name}"
+
+
+def _kernel_fn_name(kernel) -> str:
+    while isinstance(kernel, functools.partial):
+        kernel = kernel.func
+    return getattr(kernel, "__name__", str(kernel))
+
+
+def _as_list(x) -> List[Any]:
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _caller_frame() -> Tuple[str, str, int]:
+    """(relpath, function, line) of the nearest non-analysis
+    deepspeed_tpu frame that invoked ``pallas_call``."""
+    pkg = os.path.join(repo_root(), "deepspeed_tpu")
+    ana = os.path.join(pkg, "analysis")
+    f = inspect.currentframe()
+    while f is not None:
+        fn = os.path.abspath(f.f_code.co_filename)
+        if fn.startswith(pkg) and not fn.startswith(ana):
+            return relpath(fn), f.f_code.co_name, f.f_lineno
+        f = f.f_back
+    return "<unknown>", "<unknown>", 0
+
+
+@contextlib.contextmanager
+def capture_pallas_calls(captured: List[CapturedCall]):
+    """Intercept ``pl.pallas_call`` (no kernel executes, nothing
+    compiles) and run the body with jit disabled so scalar-prefetch
+    operands arrive concrete."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    real = pl.pallas_call
+
+    def fake_pallas_call(kernel, out_shape=None, *, grid_spec=None,
+                         grid=(), in_specs=None, out_specs=None,
+                         scratch_shapes=(), interpret=False, **kw):
+        del interpret, kw
+        if grid_spec is not None:
+            g = tuple(grid_spec.grid)
+            ins = _as_list(grid_spec.in_specs)
+            outs = _as_list(grid_spec.out_specs)
+            scratch = _as_list(grid_spec.scratch_shapes)
+            npf = int(getattr(grid_spec, "num_scalar_prefetch", 0) or 0)
+        else:
+            g = tuple(grid) if isinstance(grid, (tuple, list)) else (grid,)
+            ins = _as_list(in_specs)
+            outs = _as_list(out_specs)
+            scratch = _as_list(scratch_shapes)
+            npf = 0
+        path, func, line = _caller_frame()
+        out_structs = _as_list(out_shape)
+
+        def runner(*ops):
+            prefetch = []
+            for p in ops[:npf]:
+                try:
+                    prefetch.append(np.asarray(p))
+                except Exception:  # traced — case ran under a transform
+                    prefetch.append(None)
+            captured.append(CapturedCall(
+                kernel_name=_kernel_fn_name(kernel), caller_path=path,
+                caller_func=func, caller_line=line, grid=g, in_specs=ins,
+                out_specs=outs, out_shapes=out_structs,
+                scratch_shapes=scratch, num_scalar_prefetch=npf,
+                operands=[jax.ShapeDtypeStruct(o.shape, o.dtype)
+                          for o in ops], prefetch=prefetch))
+            zeros = [jnp.zeros(s.shape, s.dtype) for s in out_structs]
+            if isinstance(out_shape, (list, tuple)):
+                return type(out_shape)(zeros) if isinstance(out_shape, list) \
+                    else tuple(zeros)
+            return zeros[0]
+
+        return runner
+
+    pl.pallas_call = fake_pallas_call
+    try:
+        with jax.disable_jit():
+            yield
+    finally:
+        pl.pallas_call = real
+
+
+# --------------------------------------------------------------------- #
+# Checks over one captured call
+# --------------------------------------------------------------------- #
+def _block_shape(spec) -> Optional[Tuple[int, ...]]:
+    bs = getattr(spec, "block_shape", None)
+    if bs is None:
+        return None
+    return tuple(int(b) for b in bs)
+
+
+def _check_tiling(case, call, kind, spec, arr_shape, dtype, findings):
+    block = _block_shape(spec)
+    if block is None or len(block) < 2:
+        # memory_space=ANY (kernel-managed DMA) or rank-1 (lane tiling
+        # over the single dim; the repo's rank-1 blocks are tiny scale
+        # vectors) — nothing to check statically
+        return
+    itemsize = np.dtype(dtype).itemsize
+    sub = _SUBLANE.get(itemsize, 8)
+    bm, am = block[-1], int(arr_shape[-1])
+    if bm % _LANE != 0 and bm != am:
+        findings.append(Finding(
+            rule="pallas-tiling", path=call.caller_path,
+            line=call.caller_line, func=call.caller_func,
+            message=f"[{case.name}] {kind} block {block} of "
+                    f"{call.kernel_name}: minor dim {bm} is neither a "
+                    f"multiple of {_LANE} lanes nor the full array minor "
+                    f"dim {am}",
+            hint="pad/regroup the minor block dim to 128 lanes or make "
+                 "it cover the whole dim (Mosaic rejects or silently "
+                 "pads ragged lane tiles)"))
+    bs_, as_ = block[-2], int(arr_shape[-2])
+    if bs_ % sub != 0 and bs_ != as_:
+        findings.append(Finding(
+            rule="pallas-tiling", path=call.caller_path,
+            line=call.caller_line, func=call.caller_func,
+            message=f"[{case.name}] {kind} block {block} of "
+                    f"{call.kernel_name}: second-minor dim {bs_} is not "
+                    f"a multiple of the {np.dtype(dtype).name} sublane "
+                    f"({sub}) nor the full dim {as_}",
+            hint=f"use a multiple of {sub} rows per block for "
+                 f"{np.dtype(dtype).name} (8/16/32 for 4/2/1-byte "
+                 "dtypes)"))
+
+
+def _grid_points(grid: Tuple[int, ...]):
+    total = 1
+    for g in grid:
+        total *= max(int(g), 1)
+    if total > _MAX_GRID_POINTS:
+        return None
+    return itertools.product(*(range(int(g)) for g in grid))
+
+
+class _IndexMapError(Exception):
+    """An index map raised while being evaluated — itself a defect
+    (OOB table read, tracer-only primitive, ...), reported as a
+    finding rather than crashing the whole lint run."""
+
+
+def _eval_index_map(spec, point, prefetch) -> Optional[Tuple[int, ...]]:
+    im = getattr(spec, "index_map", None)
+    if im is None:
+        return None
+    try:
+        idx = im(*point, *prefetch)
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        return tuple(int(i) for i in idx)
+    except Exception as e:  # noqa: BLE001
+        raise _IndexMapError(f"{type(e).__name__}: {e}") from e
+
+
+def _check_maps(case, call, findings):
+    points = _grid_points(call.grid)
+    if points is None:
+        findings.append(Finding(
+            rule="pallas-grid-unchecked", path=call.caller_path,
+            line=call.caller_line, func=call.caller_func,
+            message=f"[{case.name}] grid {call.grid} of "
+                    f"{call.kernel_name} exceeds the exhaustive "
+                    f"index-map check cap ({_MAX_GRID_POINTS} points)",
+            hint="register a smaller representative shape"))
+        return
+    if any(p is None for p in call.prefetch):
+        findings.append(Finding(
+            rule="pallas-grid-unchecked", path=call.caller_path,
+            line=call.caller_line, func=call.caller_func,
+            message=f"[{case.name}] scalar-prefetch operands of "
+                    f"{call.kernel_name} were traced, not concrete — "
+                    "index maps cannot be evaluated",
+            hint="call the kernel plumbing outside jax.jit in the "
+                 "registry case"))
+        return
+
+    ops = call.operands[call.num_scalar_prefetch:]
+    # (kind, spec, shape, out index or None); zip truncation on an
+    # arity mismatch is reported separately by _check_shapes
+    specs = [("in", s, o.shape, None)
+             for s, o in zip(call.in_specs, ops)] + \
+            [("out", s, t.shape, oi)
+             for oi, (s, t) in enumerate(zip(call.out_specs,
+                                             call.out_shapes))]
+    covered: List[set] = [set() for _ in call.out_specs]
+    oob_reported = set()
+    for point in points:
+        for si, (kind, spec, shape, oi) in enumerate(specs):
+            block = _block_shape(spec)
+            if block is None:
+                continue
+            try:
+                idx = _eval_index_map(spec, point, call.prefetch)
+            except _IndexMapError as e:
+                key = (si, "raise")
+                if key not in oob_reported:
+                    oob_reported.add(key)
+                    findings.append(Finding(
+                        rule="pallas-index-map", path=call.caller_path,
+                        line=call.caller_line, func=call.caller_func,
+                        message=f"[{case.name}] {kind} index map of "
+                                f"{call.kernel_name} raised at grid "
+                                f"point {point}: {e}",
+                        hint="index maps must evaluate for every grid "
+                             "point with the real prefetch operands"))
+                continue
+            if idx is None:
+                continue
+            if len(idx) != len(block):
+                key = (si, "rank")
+                if key not in oob_reported:
+                    oob_reported.add(key)
+                    findings.append(Finding(
+                        rule="pallas-index-map", path=call.caller_path,
+                        line=call.caller_line, func=call.caller_func,
+                        message=f"[{case.name}] {kind} index map of "
+                                f"{call.kernel_name} returns rank "
+                                f"{len(idx)} for block rank {len(block)}",
+                        hint="index maps must return one block index "
+                             "per block dim"))
+                continue
+            for d, (i, b, n) in enumerate(zip(idx, block, shape)):
+                # the block ORIGIN must lie inside the array; a ragged
+                # final block (n % b != 0) is Pallas-padded, so only a
+                # fully-outside origin is an error
+                if i < 0 or i * b >= n:
+                    key = (si, d)
+                    if key in oob_reported:
+                        continue
+                    oob_reported.add(key)
+                    findings.append(Finding(
+                        rule="pallas-index-map", path=call.caller_path,
+                        line=call.caller_line, func=call.caller_func,
+                        message=f"[{case.name}] {kind} index map of "
+                                f"{call.kernel_name} at grid point "
+                                f"{point} names block {idx}: dim {d} "
+                                f"origin {i * b} is outside the array "
+                                f"dim {n} (block {b})",
+                        hint="grid x index_map must stay inside the "
+                             "operand — an OOB block DMAs garbage (or "
+                             "aborts Mosaic)"))
+            if oi is not None and len(idx) == len(block):
+                covered[oi].add(idx)
+
+    for oi, (spec, struct) in enumerate(zip(call.out_specs,
+                                            call.out_shapes)):
+        block = _block_shape(spec)
+        if block is None:
+            continue
+        need = itertools.product(
+            *(range(-(-int(n) // b)) for n, b in zip(struct.shape, block)))
+        missing = [t for t in need if t not in covered[oi]]
+        if missing:
+            findings.append(Finding(
+                rule="pallas-uncovered-tile", path=call.caller_path,
+                line=call.caller_line, func=call.caller_func,
+                message=f"[{case.name}] output {oi} of "
+                        f"{call.kernel_name}: {len(missing)} block(s) "
+                        f"never written by any grid step (first: "
+                        f"{missing[0]}, shape {tuple(struct.shape)}, "
+                        f"block {block})",
+                hint="uninitialised output tiles return whatever was in "
+                     "HBM — cover every tile or mask the result "
+                     "explicitly (and waive via the registry case's "
+                     "allow= with a comment)"))
+
+
+def _check_shapes(case, call, findings):
+    n_ops = len(call.operands) - call.num_scalar_prefetch
+    if n_ops != len(call.in_specs):
+        findings.append(Finding(
+            rule="pallas-arity", path=call.caller_path,
+            line=call.caller_line, func=call.caller_func,
+            message=f"[{case.name}] {call.kernel_name}: {n_ops} "
+                    f"non-prefetch operands vs {len(call.in_specs)} "
+                    "in_specs",
+            hint="every operand needs a BlockSpec (and vice versa)"))
+    for oi, (spec, struct) in enumerate(zip(call.out_specs,
+                                            call.out_shapes)):
+        block = _block_shape(spec)
+        if block is None:
+            continue
+        if len(block) != len(struct.shape):
+            findings.append(Finding(
+                rule="pallas-out-shape", path=call.caller_path,
+                line=call.caller_line, func=call.caller_func,
+                message=f"[{case.name}] output {oi} of "
+                        f"{call.kernel_name}: block rank {len(block)} "
+                        f"!= out_shape rank {len(struct.shape)}"))
+            continue
+        ragged = [d for d, (n, b) in enumerate(zip(struct.shape, block))
+                  if int(n) % b != 0]
+        if ragged:
+            findings.append(Finding(
+                rule="pallas-out-shape", path=call.caller_path,
+                line=call.caller_line, func=call.caller_func,
+                message=f"[{case.name}] output {oi} of "
+                        f"{call.kernel_name}: block {block} does not "
+                        f"divide out_shape {tuple(struct.shape)} "
+                        f"(dims {ragged})",
+                hint="ragged output tiles write past the logical array; "
+                     "pad the out_shape or shrink the block"))
+
+
+def _scratch_bytes(scratch) -> int:
+    shape = getattr(scratch, "shape", None)
+    dtype = getattr(scratch, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        return 0  # semaphores
+    return int(np.prod(shape)) * itemsize if len(shape) else itemsize
+
+
+def _check_vmem(case, call, findings):
+    total = 0
+    ops = call.operands[call.num_scalar_prefetch:]
+    for spec, op in zip(call.in_specs, ops):
+        block = _block_shape(spec)
+        if block is None:
+            continue
+        total += 2 * int(np.prod(block)) * np.dtype(op.dtype).itemsize
+    for spec, struct in zip(call.out_specs, call.out_shapes):
+        block = _block_shape(spec)
+        if block is None:
+            continue
+        total += 2 * int(np.prod(block)) * np.dtype(struct.dtype).itemsize
+    total += sum(_scratch_bytes(s) for s in call.scratch_shapes)
+    if total > case.vmem_limit:
+        findings.append(Finding(
+            rule="pallas-vmem-budget", path=call.caller_path,
+            line=call.caller_line, func=call.caller_func,
+            message=f"[{case.name}] {call.kernel_name}: estimated VMEM "
+                    f"working set {total / 2**20:.1f} MiB (double-"
+                    f"buffered blocks + scratch) exceeds the "
+                    f"{case.vmem_limit / 2**20:.1f} MiB budget",
+            hint="shrink the block sizes, or raise the case's "
+                 "vmem_limit= with a comment if the kernel manages "
+                 "residency itself"))
+
+
+def check_captured_call(case: "registry.KernelCase", call: CapturedCall
+                        ) -> List[Finding]:
+    findings: List[Finding] = []
+    ops = call.operands[call.num_scalar_prefetch:]
+    for spec, op in zip(call.in_specs, ops):
+        _check_tiling(case, call, "in", spec, op.shape, op.dtype, findings)
+    for spec, struct in zip(call.out_specs, call.out_shapes):
+        _check_tiling(case, call, "out", spec, struct.shape, struct.dtype,
+                      findings)
+    _check_shapes(case, call, findings)
+    _check_maps(case, call, findings)
+    _check_vmem(case, call, findings)
+    return [f for f in findings if f.rule not in case.allow]
+
+
+# --------------------------------------------------------------------- #
+# AST sweep: every pallas_call site must be reached by some case
+# --------------------------------------------------------------------- #
+def _iter_pallas_sites(pkg_dir: str):
+    """Yield (relpath, enclosing function, lineno, end_lineno) for every
+    ``pallas_call`` call expression under ``pkg_dir``."""
+    for root, dirs, files in os.walk(pkg_dir):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            try:
+                tree = ast.parse(open(path).read())
+            except SyntaxError:
+                continue
+            func_stack: List[str] = []
+
+            def walk(node):
+                is_fn = isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                if is_fn:
+                    func_stack.append(node.name)
+                if isinstance(node, ast.Call):
+                    fn = node.func
+                    attr = fn.attr if isinstance(fn, ast.Attribute) \
+                        else getattr(fn, "id", "")
+                    if attr == "pallas_call":
+                        yield (relpath(path),
+                               func_stack[-1] if func_stack else "<module>",
+                               node.lineno,
+                               getattr(node, "end_lineno", node.lineno))
+                for child in ast.iter_child_nodes(node):
+                    yield from walk(child)
+                if is_fn:
+                    func_stack.pop()
+
+            yield from walk(tree)
+
+
+def run_pallas_lint(verbose: bool = False) -> List[Finding]:
+    """Import the kernel modules, run every registered case under
+    capture, validate, and cross-check site coverage."""
+    findings: List[Finding] = []
+    for mod in registry.KERNEL_MODULES:
+        importlib.import_module(mod)
+
+    all_captured: List[CapturedCall] = []
+    for name in sorted(registry.KERNEL_CASES):
+        case = registry.KERNEL_CASES[name]
+        captured: List[CapturedCall] = []
+        try:
+            with capture_pallas_calls(captured):
+                case.fn()
+        except Exception as e:  # noqa: BLE001 — a broken case is a finding
+            findings.append(Finding(
+                rule="pallas-case-error", path="deepspeed_tpu/analysis",
+                line=0, func=name,
+                message=f"kernel case '{name}' raised "
+                        f"{type(e).__name__}: {e}",
+                hint="the registered representative invocation must run "
+                     "under capture (no TPU needed)"))
+            continue
+        if not captured:
+            findings.append(Finding(
+                rule="pallas-case-error", path="deepspeed_tpu/analysis",
+                line=0, func=name,
+                message=f"kernel case '{name}' reached no pallas_call",
+                hint="the case must exercise the kernel plumbing"))
+        for call in captured:
+            try:
+                findings.extend(check_captured_call(case, call))
+            except Exception as e:  # noqa: BLE001 — one bad call must
+                findings.append(Finding(  # not kill the whole run
+                    rule="pallas-case-error", path=call.caller_path,
+                    line=call.caller_line, func=call.caller_func,
+                    message=f"[{name}] checking {call.kernel_name} "
+                            f"raised {type(e).__name__}: {e}",
+                    hint="file a dslint bug (or fix the kernel spec the "
+                         "checker choked on)"))
+        all_captured.extend(captured)
+
+    pkg = os.path.join(repo_root(), "deepspeed_tpu")
+    hit_lines = {}
+    for call in all_captured:
+        hit_lines.setdefault((call.caller_path, call.caller_func),
+                             set()).add(call.caller_line)
+    for path, func, lineno, end in _iter_pallas_sites(pkg):
+        lines = hit_lines.get((path, func), set())
+        if any(lineno <= ln <= end for ln in lines):
+            continue
+        if lines:
+            # captured in this function but the frame line didn't fall
+            # inside this call expression — count function-level hits
+            # against the function's sites conservatively
+            continue
+        findings.append(Finding(
+            rule="pallas-unregistered-site", path=path, line=lineno,
+            func=func,
+            message=f"pallas_call site in {func} is reached by no "
+                    "registered kernel case",
+            hint="add a @pallas_kernel_case representative invocation "
+                 "(see deepspeed_tpu/analysis/registry.py)"))
+    return findings

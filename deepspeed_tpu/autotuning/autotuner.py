@@ -272,13 +272,17 @@ class Autotuner:
                 engine.backward(loss)
                 engine.step()
             jax.device_get(loss)
-            t0 = time.time()
+            # perf_counter, not time.time: the wall clock is not
+            # monotonic (NTP steps corrupt a trial); the device_get
+            # below blocks on the final step's result so the bracket
+            # measures compute, not dispatch (dslint timing-no-block)
+            t0 = time.perf_counter()
             for _ in range(self.steps_per_trial):
                 loss = engine(*args)
                 engine.backward(loss)
                 engine.step()
             jax.device_get(loss)  # axon tunnel: sync via host round-trip
-            dt = (time.time() - t0) / self.steps_per_trial
+            dt = (time.perf_counter() - t0) / self.steps_per_trial
             exp.metric_val = engine.config.train_batch_size / dt
         except Exception as e:  # noqa: BLE001 — OOM/compile failure prunes
             exp.error = f"{type(e).__name__}: {e}"

@@ -459,3 +459,63 @@ def paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         interpret=bool(interpret),
     )(token_slot.astype(jnp.int32), token_pos.astype(jnp.int32),
       block_tables.astype(jnp.int32), q, kp, vp)
+
+
+# --------------------------------------------------------------------- #
+# dslint contract-checker registration (see analysis/pallas_lint.py):
+# the selftest paged geometry — scalar-prefetched block tables drive
+# the index maps, so the bounds check runs with the REAL table values.
+# --------------------------------------------------------------------- #
+from deepspeed_tpu.analysis.registry import pallas_kernel_case  # noqa: E402
+
+
+def _dslint_paged_setup(d: int):
+    import numpy as np
+
+    bs, S, B = 128, 4, 4
+    rng = np.random.default_rng(5)
+    pool = lambda: jnp.asarray(
+        rng.standard_normal(((S * B + 1) * bs, 2, d)).astype(np.float32),
+        jnp.bfloat16)
+    tables = jnp.arange(1, S * B + 1, dtype=jnp.int32).reshape(S, B)
+    token_pos = jnp.asarray([200, 317, 64, 450], jnp.int32)
+    token_slot = jnp.arange(S, dtype=jnp.int32)
+    q = jnp.asarray(rng.standard_normal((S, 8, d)).astype(np.float32),
+                    jnp.bfloat16)
+    return bs, pool(), pool(), tables, token_slot, token_pos, q
+
+
+@pallas_kernel_case("paged_attention_grid",
+                    note="grid-(tokens, blocks) paged attention")
+def _dslint_paged_grid_case():
+    bs, kp, vp, tables, slot, pos, q = _dslint_paged_setup(64)
+    paged_attention(q, kp, vp, tables, slot, pos, block_size=bs,
+                    interpret=True)
+
+
+@pallas_kernel_case(
+    "paged_decode_dma",
+    note="O(live-context) decode kernel: KV pool stays in HBM "
+         "(memory_space=ANY blocks are exempt from the VMEM estimate; "
+         "the double-buffered block scratch is what counts)")
+def _dslint_paged_decode_dma_case():
+    bs, kp, vp, tables, slot, pos, q = _dslint_paged_setup(128)
+    paged_decode_attention(q, kp, vp, tables, slot, pos, block_size=bs,
+                           interpret=True)
+
+
+@pallas_kernel_case("paged_prefill",
+                    note="tile-aligned prefill at the shipped 125M "
+                         "serving geometry (6q/2kv heads, d=64)")
+def _dslint_paged_prefill_case():
+    import numpy as np
+
+    bs, kp, vp, tables, _slot, _pos, _q = _dslint_paged_setup(64)
+    T = 256
+    rng = np.random.default_rng(6)
+    qp = jnp.asarray(rng.standard_normal((T, 6, 64)).astype(np.float32),
+                     jnp.bfloat16)
+    paged_prefill_attention(qp, kp, vp, tables,
+                            jnp.zeros((T,), jnp.int32),
+                            jnp.arange(T, dtype=jnp.int32),
+                            block_size=bs, tile_q=128, interpret=True)

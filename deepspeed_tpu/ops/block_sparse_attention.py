@@ -393,3 +393,30 @@ def block_sparse_attention(q, k, v, bs_layout: BlockSparseLayout,
     return _bs_attn(q, k, v, bs_layout.cells, bs_layout.tile_any,
                     bs_layout.block, bs_layout.tile_q, bs_layout.tile_k,
                     float(scale), bool(interpret))
+
+
+# ===================================================================== #
+# dslint contract-checker registration (see analysis/pallas_lint.py):
+# a ~50%-density layout with a guaranteed-live diagonal (every q tile
+# row has work, so the dead-tile-clamped output index maps still cover
+# every output block), forward + both backward kernels.
+# ===================================================================== #
+from deepspeed_tpu.analysis.registry import pallas_kernel_case  # noqa: E402
+
+
+@pallas_kernel_case("block_sparse_attention",
+                    note="BigBird-style layout, fwd + dq + dkv kernels")
+def _dslint_block_sparse_case():
+    h, s, d, blk = 4, 512, 64, 64
+    rng = np.random.default_rng(3)
+    layout = (rng.random((h, s // blk, s // blk)) < 0.5)
+    layout |= np.eye(s // blk, dtype=bool)[None]
+    bsl = BlockSparseLayout(layout.astype(np.int32), blk, s)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((2, h, s, d)).astype(np.float32), jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    o = block_sparse_attention(q, k, v, bsl, interpret=True)
+    lse = jnp.zeros((2, h, s, 8), jnp.float32)
+    _bwd((q, k, v, o, lse, bsl.cells, bsl.tile_any), (o,), block=blk,
+         block_q=bsl.tile_q, block_k=bsl.tile_k, scale=0.125,
+         interpret=True)

@@ -331,6 +331,26 @@ def DS4Sci_EvoformerAttention(Q: jnp.ndarray, K: jnp.ndarray,
     return _evoformer(Q, K, V, bs, interpret)
 
 
+# --------------------------------------------------------------------- #
+# dslint contract-checker registration (see analysis/pallas_lint.py):
+# the selftest AlphaFold-ish shape with a broadcast pair bias (the
+# broadcast-dim->block-0 index maps are exactly what the bounds check
+# needs to see).
+# --------------------------------------------------------------------- #
+from deepspeed_tpu.analysis.registry import pallas_kernel_case  # noqa: E402
+
+
+@pallas_kernel_case("evoformer_attn",
+                    note="pair-bias flash fwd with broadcast bias specs")
+def _dslint_evoformer_case():
+    rng = np.random.default_rng(4)
+    mk = lambda shape: jnp.asarray(
+        rng.standard_normal(shape).astype(np.float32), jnp.bfloat16)
+    Q, K, V = (mk((1, 4, 256, 4, 32)) for _ in range(3))
+    pair = mk((1, 1, 4, 256, 256))
+    DS4Sci_EvoformerAttention(Q, K, V, [pair], interpret=True)
+
+
 class EvoformerAttnBuilder:
     NAME = "evoformer_attn"
 

@@ -995,3 +995,63 @@ def flash_attention_folded(q, k, v, *, num_heads: int,
                          bool(causal), int(block_q), int(block_k),
                          bool(interpret),
                          int(window) if window is not None else None)
+
+
+# ===================================================================== #
+# dslint contract-checker registration (see analysis/pallas_lint.py):
+# the kernel_selftest parameter grid, invoked under the checker's
+# capture context — no kernel body runs, nothing compiles.
+# ===================================================================== #
+from deepspeed_tpu.analysis.registry import pallas_kernel_case  # noqa: E402
+
+
+def _dslint_qkv(h, hkv, d, s=512, b=2, dtype=jnp.bfloat16):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    mk = lambda heads: jnp.asarray(
+        rng.standard_normal((b, s, heads, d)).astype(np.float32), dtype)
+    return mk(h), mk(hkv), mk(hkv)
+
+
+@pallas_kernel_case(
+    "flash_attention",
+    note="selftest grid (MHA d64 / GQA d128 / SWA) + multi-k fwd and "
+         "both backward kernels at 128x128 blocks")
+def _dslint_flash_cases():
+    for h, hkv, d, win in ((8, 8, 64, None), (8, 2, 128, None),
+                           (4, 4, 64, 256)):
+        q, k, v = _dslint_qkv(h, hkv, d)
+        flash_attention(q, k, v, causal=True, window=win, interpret=True)
+    h, hkv, d, bq, bk = 4, 2, 64, 128, 128
+    q, k, v = _dslint_qkv(h, hkv, d)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    o, lse = _fwd(qt, kt, vt, causal=True, block_q=bq, block_k=bk,
+                  interpret=True)
+    _bwd((qt, kt, vt, o, lse), (o,), scale=0.125, causal=True,
+         block_q=bq, block_k=bk, interpret=True)
+
+
+@pallas_kernel_case(
+    "flash_attention_folded",
+    note="folded [B,S,H*D] lane layout incl. the d=64 head-group lane "
+         "slicing (hb>1) and hb==1 (d=128) BlockSpecs")
+def _dslint_flash_folded_cases():
+    for h, hkv, d, win in ((12, 12, 64, None), (8, 4, 64, None),
+                           (8, 2, 128, None), (4, 4, 64, 256)):
+        q, k, v = _dslint_qkv(h, hkv, d)
+        b, s = q.shape[:2]
+        flash_attention_folded(
+            q.reshape(b, s, h * d), k.reshape(b, s, hkv * d),
+            v.reshape(b, s, hkv * d), num_heads=h, num_kv_heads=hkv,
+            causal=True, window=win, interpret=True)
+    h, hkv, d, bq, bk = 4, 2, 64, 128, 128
+    q, k, v = _dslint_qkv(h, hkv, d)
+    b, s = q.shape[:2]
+    qf = q.reshape(b, s, h * d)
+    kf = k.reshape(b, s, hkv * d)
+    vf = v.reshape(b, s, hkv * d)
+    o, lse = _fwd_folded(qf, kf, vf, h=h, hkv=hkv, causal=True,
+                         block_q=bq, block_k=bk, interpret=True)
+    _bwd_folded((qf, kf, vf, o, lse), (o,), h=h, hkv=hkv, scale=0.125,
+                causal=True, block_q=bq, block_k=bk, interpret=True)

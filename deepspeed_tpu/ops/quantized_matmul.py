@@ -156,3 +156,23 @@ def qmm(x: jnp.ndarray, leaf, dtype=None) -> jnp.ndarray:
     if is_quant_record(leaf):
         return quantized_matmul(x, leaf)
     return x @ (leaf.astype(dtype) if dtype is not None else leaf)
+
+
+# --------------------------------------------------------------------- #
+# dslint contract-checker registration (see analysis/pallas_lint.py).
+# --------------------------------------------------------------------- #
+from deepspeed_tpu.analysis.registry import pallas_kernel_case  # noqa: E402
+
+
+@pallas_kernel_case("quantized_matmul",
+                    note="int8-resident weight matmul, selftest shape")
+def _dslint_qmm_case():
+    import numpy as np
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((128, 512)).astype(np.float32),
+                    jnp.bfloat16)
+    rec = {"q": jnp.asarray(
+               rng.integers(-127, 128, (512, 512)).astype(np.int8)),
+           "scale": jnp.ones((4,), jnp.float32)}
+    quantized_matmul(x, rec, interpret=True)

@@ -207,6 +207,29 @@ def _quantize_kernel(x_ref, q_ref, s_ref):
     s_ref[...] = scale[:, 0]
 
 
+def _quantize_kernel_call(g: jnp.ndarray):
+    """``pallas_call`` plumbing for the symmetric int8 groupwise
+    quantize (factored out of :func:`quantize_pallas` so the dslint
+    contract checker can reach it off-TPU). ``g``: [ng, group_size]."""
+    from jax.experimental import pallas as pl
+
+    ng, gs = g.shape
+    # int8 output tiles pack 32 sublanes: prefer a 32-row block so the
+    # q_ref writes stay tile-aligned (8-row blocks forced a Mosaic
+    # relayout of the int8 output)
+    block_g = 32 if ng % 32 == 0 else (8 if ng % 8 == 0 else 1)
+    out = pl.pallas_call(
+        _quantize_kernel,
+        grid=(ng // block_g,),
+        in_specs=[pl.BlockSpec((block_g, gs), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block_g, gs), lambda i: (i, 0)),
+                   pl.BlockSpec((block_g,), lambda i: (i,))],
+        out_shape=[jax.ShapeDtypeStruct((ng, gs), jnp.int8),
+                   jax.ShapeDtypeStruct((ng,), jnp.float32)],
+    )(g)
+    return out[0], out[1]
+
+
 @functools.partial(jax.jit, static_argnums=(1,))
 def quantize_pallas(x: jnp.ndarray, num_groups: int):
     """Pallas symmetric int8 quantize; one grid step per group block.
@@ -221,21 +244,23 @@ def quantize_pallas(x: jnp.ndarray, num_groups: int):
     if platform != "tpu":
         q, s, _ = quantize(x, num_groups, 8, True)
         return q, s
-    from jax.experimental import pallas as pl
+    return _quantize_kernel_call(_group(x, num_groups))
 
-    g = _group(x, num_groups)
-    ng, gs = g.shape
-    block_g = 8 if ng % 8 == 0 else 1
-    out = pl.pallas_call(
-        _quantize_kernel,
-        grid=(ng // block_g,),
-        in_specs=[pl.BlockSpec((block_g, gs), lambda i: (i, 0))],
-        out_specs=[pl.BlockSpec((block_g, gs), lambda i: (i, 0)),
-                   pl.BlockSpec((block_g,), lambda i: (i,))],
-        out_shape=[jax.ShapeDtypeStruct((ng, gs), jnp.int8),
-                   jax.ShapeDtypeStruct((ng,), jnp.float32)],
-    )(g)
-    return out[0], out[1]
+
+# ------------------------------------------------------------------ #
+# dslint contract-checker registration (see analysis/pallas_lint.py):
+# runs only under the checker's capture context, never in production.
+# ------------------------------------------------------------------ #
+from deepspeed_tpu.analysis.registry import pallas_kernel_case  # noqa: E402
+
+
+@pallas_kernel_case("quantizer_int8",
+                    note="symmetric int8 groupwise quantize hot path")
+def _dslint_quantizer_case():
+    import numpy as np
+
+    x = jnp.asarray(np.linspace(-1.0, 1.0, 64 * 512, dtype=np.float32))
+    _quantize_kernel_call(_group(x, 64))
 
 
 class QuantizerBuilder:

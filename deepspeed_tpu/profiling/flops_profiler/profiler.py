@@ -255,10 +255,12 @@ class FlopsProfiler:
         """Lower/compile ``fn``, time one execution, record its cost —
         including the per-module attribution (name-stack jaxpr walk)."""
         compiled = jax.jit(fn).lower(*args, **kwargs).compile()
-        t0 = time.time()
+        # monotonic clock + block on the result before stopping it
+        # (dslint timing-no-block: time.time can step backwards)
+        t0 = time.perf_counter()
         out = compiled(*args, **kwargs)
         jax.block_until_ready(out)
-        dt = time.time() - t0
+        dt = time.perf_counter() - t0
         self.profile_compiled(name, compiled, duration=dt)
         try:
             self._per_module = per_module_flops(fn, *args, **kwargs)
@@ -343,10 +345,11 @@ def get_model_profile(model: Callable, args: Tuple = (), kwargs: Dict = None,
     compiled = jax.jit(model).lower(*args, **kwargs).compile()
     for _ in range(max(0, warm_up)):
         jax.block_until_ready(compiled(*args, **kwargs))
-    t0 = time.time()
+    t0 = time.perf_counter()
     out = compiled(*args, **kwargs)
     jax.block_until_ready(out)
-    prof.profile_compiled("model", compiled, duration=time.time() - t0)
+    prof.profile_compiled("model", compiled,
+                          duration=time.perf_counter() - t0)
     # count params: any array-leaf argument that looks like a weight tree
     prof._params = params_of(args) + params_of(kwargs)
     if print_profile:

@@ -293,7 +293,12 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
-        self.skipped_steps = 0
+        # fp16 skipped-step tally: a host int base plus an ON-DEVICE
+        # overflow accumulator, so the hot path never blocks to read the
+        # flag (the `skipped_steps` property fetches lazily)
+        self._skipped_steps_base = 0
+        self._overflow_accum = None
+        self._skipped_steps_logged = 0
         self._last_loss = None
         self._seen_backward = False
         self.training = True
@@ -1064,17 +1069,19 @@ class DeepSpeedEngine:
         self._update_data_efficiency()
         self._maybe_profile_flops()
         if self.fp16_enabled:
-            # overflow is tiny; fetching it keeps skipped_steps accurate
-            if bool(jax.device_get(overflow)):
-                self.skipped_steps += 1
-                log_dist(
-                    f"step {self.global_steps}: fp16 overflow, skipping "
-                    f"update (loss scale -> "
-                    f"{float(jax.device_get(self.state['loss_scale']))})",
-                    ranks=[0])
+            # Accumulate the overflow flag ON DEVICE: the add dispatches
+            # asynchronously, where the previous bool(jax.device_get(..))
+            # blocked the host on the device EVERY step (dslint
+            # step-host-sync). The tally is fetched only at reporting
+            # boundaries / checkpointing via the skipped_steps property.
+            flag = jnp.asarray(overflow).astype(jnp.int32)
+            self._overflow_accum = flag if self._overflow_accum is None \
+                else self._overflow_accum + flag
         if self.lr_scheduler is not None:
             self.lr_scheduler.step(self.global_steps)
         if self.global_steps % self.config.steps_per_print == 0:
+            if self.fp16_enabled:
+                self._log_fp16_skips()
             if self.config.wall_clock_breakdown:
                 self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER],
                                 memory_breakdown=True)
@@ -1084,6 +1091,20 @@ class DeepSpeedEngine:
                     ("Train/samples_per_sec",
                      self.tput_timer.avg_samples_per_sec(),
                      self.global_steps)])
+
+    def _log_fp16_skips(self) -> None:
+        """Reporting-boundary fp16 skip log: ONE sync covers the whole
+        window (deliberately outside the step functions so the dslint
+        step-host-sync rule keeps the hot path honest)."""
+        skipped = self.skipped_steps
+        if skipped > self._skipped_steps_logged:
+            log_dist(
+                f"step {self.global_steps}: "
+                f"{skipped - self._skipped_steps_logged} fp16 overflow "
+                f"step(s) skipped since last report (loss scale -> "
+                f"{float(jax.device_get(self.state['loss_scale']))})",
+                ranks=[0])
+        self._skipped_steps_logged = skipped
 
     def _maybe_profile_flops(self):
         """One-shot compiler-derived flops profile at ``profile_step``
@@ -1199,6 +1220,22 @@ class DeepSpeedEngine:
     @property
     def params(self):
         return self.state["params"] if self.state else None
+
+    @property
+    def skipped_steps(self) -> int:
+        """fp16 steps skipped on overflow. Reading this SYNCS (fetches
+        the on-device overflow tally); the hot path never reads it —
+        only checkpointing, reporting, and user introspection do."""
+        if self._overflow_accum is None:
+            return self._skipped_steps_base
+        return self._skipped_steps_base + int(
+            jax.device_get(self._overflow_accum))
+
+    @skipped_steps.setter
+    def skipped_steps(self, value: int) -> None:
+        self._skipped_steps_base = int(value)
+        self._overflow_accum = None
+        self._skipped_steps_logged = int(value)
 
     def get_global_grad_norm(self):
         return None  # populated after step via return value

@@ -36,3 +36,13 @@ def _reset_global_mesh():
 @pytest.fixture
 def devices():
     return jax.devices()
+
+
+@pytest.fixture
+def trace_guard():
+    """dslint runtime guard (deepspeed_tpu/analysis/trace_guard.py):
+    wrap a warmed-up region to assert it never recompiles or syncs —
+    ``with trace_guard(max_compiles=0, max_host_syncs=0): step()``."""
+    from deepspeed_tpu.analysis.trace_guard import TraceGuard
+
+    return TraceGuard

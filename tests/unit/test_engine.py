@@ -278,3 +278,30 @@ def test_train_batch_from_iterator():
               for _ in range(6)]
     assert e.global_steps == 6
     assert losses[-1] < losses[0], losses
+
+
+# --------------------------------------------------------------------- #
+# dslint trace guard: the steady-state fp16 train step must neither
+# recompile nor block the host on the device (the overflow flag used to
+# be fetched with bool(jax.device_get(..)) every step — ISSUE 5).
+# --------------------------------------------------------------------- #
+@pytest.mark.skipif(not hasattr(jax, "shard_map"),
+                    reason="engine mesh path needs jax.shard_map "
+                           "(jax>=0.5); see test_pipe for the same gate")
+def test_steady_state_fp16_step_recompile_and_sync_free(trace_guard):
+    engine = _make_engine(_config(zero_stage=2, dtype="fp16",
+                                  steps_per_print=1000))
+    x, y = random_batch(16, HIDDEN)
+    for _ in range(3):  # warm: fwd/bwd/apply compiles + eager op tails
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    with trace_guard(max_compiles=0, max_host_syncs=0,
+                     label="fp16 train step") as tg:
+        for _ in range(3):
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+    assert tg.compiles == 0 and tg.host_syncs == 0
+    # the tally is still exact when somebody finally asks
+    assert engine.skipped_steps == 0

@@ -84,9 +84,71 @@ def run_smoke(n_requests: int = 8, seed: int = 0) -> dict:
     return snap
 
 
+def run_decode_guard(n_ticks: int = 4, warm_ticks: int = 2,
+                     seed: int = 1) -> dict:
+    """Prove the warmed-up decode tick is steady-state: after
+    ``warm_ticks`` decode ticks, ``n_ticks`` further ticks must build
+    ZERO new executables (dslint TraceGuard; the implicit device→host
+    transfer guard is armed too — vacuous on the CPU backend, teeth on
+    a real TPU). Raises TraceGuardError on any recompile."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.analysis.trace_guard import TraceGuard
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.serving import (ContinuousBatchScheduler,
+                                       RequestState, SamplingParams)
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.key(0), np.zeros((1, 4), np.int32))["params"]
+    # KV sized so nothing preempts: the guarded region must be pure
+    # steady-state decode
+    eng_cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": 32,
+                          "max_ragged_sequence_count": 4,
+                          "max_context": 64},
+        "kv_cache": {"block_size": 8, "num_blocks": 17},
+    })
+    engine = InferenceEngineV2(RaggedLlama(cfg, 8), params, eng_cfg)
+    sched = ContinuousBatchScheduler(engine)
+
+    rng = np.random.default_rng(seed)
+    sampling = SamplingParams(greedy=True,
+                              max_new_tokens=warm_ticks + n_ticks + 4)
+    for _ in range(2):
+        sched.submit(rng.integers(0, cfg.vocab_size, size=(4,)).tolist(),
+                     sampling=sampling)
+    # prefill + enter decode, then warm the decode-tick programs
+    for _ in range(32):
+        sched.step()
+        running = list(sched._running.values())
+        if len(running) == 2 and all(
+                r.state is RequestState.DECODE for r in running):
+            break
+    else:
+        raise AssertionError("requests never reached steady-state decode")
+    for _ in range(warm_ticks):
+        sched.step()
+
+    with TraceGuard(max_compiles=0, d2h="disallow",
+                    label="serving decode tick") as tg:
+        for _ in range(n_ticks):
+            emitted = sched.step()
+            assert emitted, "decode tick emitted no tokens"
+    sched.run_until_idle()
+    return {"decode_guard": "ok", "guarded_ticks": n_ticks,
+            "compiles": tg.compiles, "host_syncs": tg.host_syncs}
+
+
 def main() -> int:
     t0 = time.monotonic()
     snap = run_smoke()
+    snap.update(run_decode_guard())
     snap["wall_s"] = round(time.monotonic() - t0, 2)
     print(json.dumps({"serving_smoke": "ok", **snap}))
     return 0
