@@ -34,6 +34,11 @@ class KVCacheConfig(DeepSpeedConfigModel):
     block_size: int = 64
     num_blocks: Optional[int] = None     # None -> derived from max_context
     cache_dtype: Any = None
+    #: radix prefix cache over the block pool: requests sharing a token
+    #: prefix (system prompts, preempt-resume recompute) attach to warm KV
+    #: blocks instead of re-prefilling them (ref-counted, LRU-evicted
+    #: under pressure, copy-on-write on shared-block writes)
+    enable_prefix_cache: bool = False
 
 
 @dataclasses.dataclass

@@ -171,6 +171,24 @@ class InferenceEngineV2:
                 blocks += self.state_manager.blocks_needed(seq, n)
         return blocks <= self.state_manager.free_blocks
 
+    def attach_prefix(self, uid: int, tokens: Sequence[int]) -> int:
+        """Create sequence ``uid`` (it must not be live) attached to the
+        warm KV blocks covering the longest cached prefix of ``tokens``.
+        Returns the number of prefill tokens skipped (0 when the prefix
+        cache is disabled or misses) — the caller feeds only
+        ``tokens[cached:]`` through :meth:`put`.  The serving scheduler
+        calls this at admission so SplitFuse chunking starts past the
+        cached span."""
+        seq = self.state_manager.get_or_create_sequence(uid)
+        return self.state_manager.attach_prefix(
+            seq, [int(t) for t in tokens])
+
+    @property
+    def prefix_cache_stats(self):
+        """Live :class:`PrefixCacheStats` (None when caching is off)."""
+        pc = self.state_manager.prefix_cache
+        return pc.stats if pc is not None else None
+
     # ------------------------------------------------------------------ #
     # put (reference engine_v2.py:107)
     # ------------------------------------------------------------------ #
@@ -192,7 +210,14 @@ class InferenceEngineV2:
         for uid, toks in zip(uids, tokens):
             if len(toks) == 0:
                 raise ValueError(f"put: empty token list for uid {uid}")
+            fresh = self.state_manager.get_sequence(uid) is None
             seq = self.state_manager.get_or_create_sequence(uid)
+            if fresh:
+                # new sequence: skip the prefill of any cached prefix
+                # (sequences pre-created via attach_prefix already did)
+                cached = self.state_manager.attach_prefix(seq, toks)
+                if cached:
+                    toks = toks[cached:]
             if seq.seen_tokens + len(seq.pending) + len(toks) > max_context:
                 raise RuntimeError(
                     f"sequence {uid} would exceed max_context {max_context} "
@@ -295,8 +320,10 @@ class InferenceEngineV2:
         for slot, (uid, done) in enumerate(zip(scheduled, drained)):
             seq = sm.get_sequence(uid)
             n = self._batch.chunk_sizes[slot]
+            sm.record_fed_tokens(seq, seq.pending[:n])
             seq.seen_tokens += n
             del seq.pending[:n]
+            sm.register_prefix(seq)
             if done:
                 if not sync:
                     out[uid] = logits[slot]        # lazy device row
@@ -392,11 +419,18 @@ class InferenceEngineV2:
                     sm.kv_cache.update(jax.tree_util.tree_map(
                         jnp.zeros_like, sm.kv_cache.cache))
                     sm.flush(list(sm._seqs))
+                    if sm.prefix_cache is not None:
+                        sm.prefix_cache.clear()   # cached KV is gone too
                     break
             raise
         sm.kv_cache.update(new_cache)
-        for seq in seqs:
+        host_toks = (None if isinstance(tokens, jax.Array)
+                     else [int(t) for t in tokens])
+        for i, seq in enumerate(seqs):
+            if host_toks is not None:
+                sm.record_fed_tokens(seq, host_toks[i:i + 1])
             seq.seen_tokens += 1
+            sm.register_prefix(seq)
         # device positions advanced in lockstep with seen_tokens
         self._dev_decode_state = {
             "tables": state["tables"], "pos": new_pos,
@@ -517,9 +551,15 @@ class InferenceEngineV2:
         out_tokens, new_cache = runner(self.params, sm.kv_cache.cache,
                                        packed)
         sm.kv_cache.update(new_cache)
-        for seq in seqs:
+        result = np.asarray(jax.device_get(out_tokens)).T[:len(uids)]
+        for i, seq in enumerate(seqs):
+            # KV was written for the fed token plus all but the last
+            # generated one — their values are on host now
+            sm.record_fed_tokens(
+                seq, [int(tokens[i])] + result[i][:-1].tolist())
             seq.seen_tokens += steps
-        return np.asarray(jax.device_get(out_tokens)).T[:len(uids)]
+            sm.register_prefix(seq)
+        return result
 
     def _get_decode_loop(self, steps: int):
         key = ("decode_loop", steps)

@@ -96,5 +96,7 @@ class RaggedFalcon:
             # parallel residual
             x = x + attn + mlp
         x = _layer_norm(x, params["ln_f"], cfg.layer_norm_epsilon)
-        logits = x.astype(dt) @ emb.T                 # tied unembedding
-        return logits[batch["logits_idx"]], new_cache
+        # tied unembedding; slot rows gathered BEFORE the vocab matmul so
+        # prefill buckets don't unembed every token row
+        x = x[batch["logits_idx"]]
+        return x.astype(dt) @ emb.T, new_cache

@@ -179,33 +179,36 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
         # every pool row ONCE — no [T, C, Hkv, D] gather copy, no Pallas
         # grid overhead — is the bandwidth-minimal program (measured
         # 0.46 vs 1.7 ms/step for 12 layers of a 125M-GQA model on
-        # v5e).  Row->sequence ownership and row->absolute-position maps
-        # are derived from the block tables (append-ordered contract);
-        # XLA CSE dedupes the derivation across layers.  Pools much
-        # larger than the live contexts (rows > 2*S*C) take the gather
-        # path below instead, which is bounded by the block-table extent.
+        # v5e).  Visibility is derived PER TOKEN against that token's
+        # own block table — NOT via a row->owner scatter, which breaks
+        # under the prefix cache where one warm block legitimately sits
+        # in several sequences' tables (last-write-wins ownership would
+        # mask a shared block out of every table but one).  The [T, B,
+        # rows] compare is decode-sized (T == S) and XLA CSE dedupes it
+        # across layers.  Pools much larger than the live contexts
+        # (rows > 2*S*C) take the gather path below instead, which is
+        # bounded by the block-table extent.
         from deepspeed_tpu.inference.v2.ragged.blocked_allocator import (
             BlockedAllocator)
 
         trash = BlockedAllocator.TRASH_BLOCK
         rows = k_pool.shape[0]
-        nb = rows // block_size
-        owner_blk = jnp.full((nb,), -1, jnp.int32).at[
-            block_tables.ravel()].set(
-            jnp.repeat(jnp.arange(S, dtype=jnp.int32), B)).at[trash].set(-1)
-        base_blk = jnp.zeros((nb,), jnp.int32).at[block_tables.ravel()].set(
-            jnp.tile(jnp.arange(B, dtype=jnp.int32) * block_size, S))
-        row_owner = jnp.repeat(owner_blk, block_size)          # [rows]
-        row_pos = (jnp.repeat(base_blk, block_size)
-                   + jnp.tile(jnp.arange(block_size, dtype=jnp.int32), nb))
+        rowblk = jnp.arange(rows, dtype=jnp.int32) // block_size
+        rowoff = jnp.arange(rows, dtype=jnp.int32) % block_size
+        tbl = block_tables[token_slot]                         # [T, B]
+        match = tbl[:, :, None] == rowblk[None, None, :]       # [T, B, rows]
+        # absolute position of each visible row in ITS table slot
+        j_idx = jnp.argmax(match, axis=1).astype(jnp.int32)    # [T, rows]
+        row_pos = j_idx * block_size + rowoff[None, :]
         qg = q.reshape(q.shape[0], hkv, group, q.shape[2])
         scores = jnp.einsum("tkgd,rkd->tkgr", qg, k_pool,
                             preferred_element_type=jnp.float32) / jnp.sqrt(
             jnp.float32(q.shape[-1]))
-        keep = ((row_owner[None, :] == token_slot[:, None])
-                & (row_pos[None, :] <= token_pos[:, None]))    # [T, rows]
+        keep = (jnp.any(match, axis=1)
+                & (row_pos <= token_pos[:, None])
+                & (rowblk != trash)[None, :])                  # [T, rows]
         if window is not None:
-            keep &= row_pos[None, :] > token_pos[:, None] - window
+            keep &= row_pos > token_pos[:, None] - window
         # FINITE mask value: a pad slot owns no rows, so -inf would
         # softmax to NaN and poison the residual stream
         scores = jnp.where(keep[:, None, None, :], scores, -1e30)
@@ -410,15 +413,17 @@ class RaggedLlama:
                 mo = jax.lax.psum(mo, ax)         # row-parallel mlp-down
             x = x + mo
         x = _rms_norm(x, m["norm"]["scale"], cfg.rms_norm_eps)
+        # ★logits_gather analog: slice each slot's last token BEFORE the
+        # unembed matmul — [S, H] @ [H, V] instead of [T, V] over every
+        # packed token row (a SplitFuse prefill bucket is T >> S, so the
+        # full-width unembed wastes T/S of the vocab matmul and its [T, V]
+        # HBM writes); (TP) all-gathers only the [S, V/tp] slice
+        # (reference sharding/unembed.py gathers the sliced logits too)
+        x = x[batch["logits_idx"]]
         if cfg.tie_word_embeddings:
             logits = x @ m["embed_tokens"]["embedding"].astype(dt).T
-            # tied unembed against the vocab-split table: gather below
         else:
             logits = qmm(x, params["lm_head"]["kernel"], dt)
-        # ★logits_gather analog: slice each slot's last token FIRST, then
-        # (TP) all-gather only the [S, V/tp] slice (reference
-        # sharding/unembed.py gathers the sliced logits too)
-        logits = logits[batch["logits_idx"]]
         if ax is not None:
             logits = jax.lax.all_gather(logits, ax, axis=1, tiled=True)
         return logits, new_cache

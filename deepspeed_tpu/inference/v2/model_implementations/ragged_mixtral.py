@@ -125,5 +125,7 @@ class RaggedMixtral:
                 xm, lp["block_sparse_moe"]["deepspeed_moe"],
                 cfg.num_experts_per_tok, dt)
         x = _rms_norm(x, params["norm"]["scale"], cfg.rms_norm_eps)
-        logits = x @ params["lm_head"]["kernel"].astype(dt)
-        return logits[batch["logits_idx"]], new_cache
+        # slot rows gathered BEFORE the vocab matmul (prefill buckets
+        # would otherwise unembed every packed token row)
+        x = x[batch["logits_idx"]]
+        return x @ params["lm_head"]["kernel"].astype(dt), new_cache

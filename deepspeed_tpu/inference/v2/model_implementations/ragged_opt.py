@@ -112,6 +112,7 @@ class RaggedOPT:
             x = _layer_norm(x, params["final_layer_norm"],
                             cfg.layer_norm_eps)
         # tied unembedding in compute dtype (matches models/opt.py's
-        # flax Embed.attend promotion)
-        logits = x.astype(dt) @ emb.T
-        return logits[batch["logits_idx"]], new_cache
+        # flax Embed.attend promotion); slot rows gathered BEFORE the
+        # vocab matmul so prefill buckets don't unembed every token row
+        x = x[batch["logits_idx"]]
+        return x.astype(dt) @ emb.T, new_cache

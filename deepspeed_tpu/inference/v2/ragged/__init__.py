@@ -2,6 +2,8 @@
 
 from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
 from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.ragged.prefix_cache import (PrefixCacheStats,
+                                                            RadixPrefixCache)
 from deepspeed_tpu.inference.v2.ragged.ragged_manager import DSStateManager
 from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
 from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import (
@@ -9,4 +11,5 @@ from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import (
 )
 
 __all__ = ["BlockedAllocator", "BlockedKVCache", "DSStateManager",
-           "RaggedBatchWrapper", "DSSequenceDescriptor"]
+           "PrefixCacheStats", "RadixPrefixCache", "RaggedBatchWrapper",
+           "DSSequenceDescriptor"]

@@ -10,6 +10,7 @@ paged-attention kernel can later consume the same layout unchanged.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict
 
 import jax
@@ -39,7 +40,26 @@ class BlockedKVCache:
     def update(self, new_cache) -> None:
         self.cache = new_cache
 
+    def copy_block(self, src: int, dst: int) -> None:
+        """Copy one block's KV rows ``src -> dst`` across every layer (the
+        prefix cache's copy-on-write fork).  One jitted program per cache
+        geometry — src/dst are traced scalars, so forking different blocks
+        never recompiles; the old cache is donated (in-place on device)."""
+        self.cache = _copy_block(self.cache, jnp.int32(src), jnp.int32(dst),
+                                 self.block_size)
+
     @property
     def per_token_bytes(self) -> int:
         itemsize = jnp.dtype(self.dtype).itemsize
         return 2 * self.num_layers * self.num_kv_heads * self.head_dim * itemsize
+
+
+@partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
+def _copy_block(cache, src, dst, block_size: int):
+    def one(arr):
+        rows = jax.lax.dynamic_slice_in_dim(arr, src * block_size,
+                                            block_size, axis=0)
+        return jax.lax.dynamic_update_slice_in_dim(arr, rows,
+                                                   dst * block_size, axis=0)
+
+    return jax.tree_util.tree_map(one, cache)

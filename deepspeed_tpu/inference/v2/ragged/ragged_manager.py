@@ -5,16 +5,25 @@ Host-side bookkeeping only: which sequences are live, how many KV blocks each
 owns, and whether a proposed ragged batch fits the cache.  All device state
 lives in :class:`BlockedKVCache` and is threaded functionally through the
 jitted forward by the engine.
+
+With ``kv_cache.enable_prefix_cache`` the manager also owns a
+:class:`RadixPrefixCache`: new sequences attach to warm KV blocks covering
+their longest cached token prefix (:meth:`attach_prefix`), full blocks are
+registered back into the tree as prefill/decode advances
+(:meth:`register_prefix`), and allocation evicts cold cache entries under
+KV pressure — ``free_blocks`` counts evictable warm blocks as free, so the
+scheduler's admission view stays truthful.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, Optional
+from typing import Dict, Iterable, List, Optional, Sequence
 
 from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig,
                                                   KVCacheConfig)
 from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
 from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.ragged.prefix_cache import RadixPrefixCache
 from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import (
     DSSequenceDescriptor,
 )
@@ -42,6 +51,9 @@ class DSStateManager:
             kwargs["dtype"] = kv_config.cache_dtype or dtype
         self.kv_cache = BlockedKVCache(num_layers, num_blocks, self.block_size,
                                        num_kv_heads, head_dim, **kwargs)
+        self.prefix_cache: Optional[RadixPrefixCache] = (
+            RadixPrefixCache(self.allocator, self.block_size)
+            if getattr(kv_config, "enable_prefix_cache", False) else None)
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
 
     # ------------------------------------------------------------------ #
@@ -53,7 +65,13 @@ class DSStateManager:
 
     @property
     def free_blocks(self) -> int:
-        return self.allocator.free_blocks
+        """Schedulable capacity: genuinely free blocks plus warm cache
+        blocks nothing but the radix tree still references (allocation
+        evicts those on demand)."""
+        free = self.allocator.free_blocks
+        if self.prefix_cache is not None:
+            free += self.prefix_cache.evictable_blocks
+        return free
 
     def get_sequence(self, uid: int) -> Optional[DSSequenceDescriptor]:
         return self._seqs.get(uid)
@@ -72,15 +90,25 @@ class DSStateManager:
     def blocks_needed(self, seq: DSSequenceDescriptor, new_tokens: int) -> int:
         return seq.tokens_needed_capacity(new_tokens, self.block_size)
 
+    def _allocate(self, num_blocks: int) -> List[int]:
+        """Allocate, evicting cold prefix-cache entries when the free list
+        alone cannot cover the request."""
+        short = num_blocks - self.allocator.free_blocks
+        if short > 0 and self.prefix_cache is not None:
+            self.prefix_cache.evict(short)
+        return self.allocator.allocate(num_blocks)
+
     def maybe_allocate_kv(self, seq: DSSequenceDescriptor,
                           new_tokens: int) -> None:
         """reference engine_v2.py maybe_allocate_kv: grow the block table."""
         need = self.blocks_needed(seq, new_tokens)
         if need:
-            seq.blocks.extend(self.allocator.allocate(need))
+            seq.blocks.extend(self._allocate(need))
 
     def flush_sequence(self, uid: int) -> None:
-        """reference flush: release a finished sequence's KV blocks."""
+        """reference flush: release a finished sequence's KV blocks.
+        Shared (prefix-cached) blocks just drop this sequence's reference
+        — the radix tree keeps them warm for the next matching request."""
         seq = self._seqs.pop(uid, None)
         if seq is None:
             raise ValueError(f"unknown sequence uid {uid}")
@@ -90,3 +118,94 @@ class DSStateManager:
     def flush(self, uids: Iterable[int]) -> None:
         for uid in uids:
             self.flush_sequence(uid)
+
+    # ------------------------------------------------------------------ #
+    # Prefix cache (attach on admission, register as KV fills)
+    # ------------------------------------------------------------------ #
+    def attach_prefix(self, seq: DSSequenceDescriptor,
+                      tokens: Sequence[int]) -> int:
+        """Attach a FRESH sequence to the warm KV blocks covering its
+        longest cached prefix of ``tokens``; returns the number of prompt
+        tokens whose prefill is thereby skipped (0 on miss / cache off).
+
+        At least one token is always left to run — the engine must still
+        produce last-token logits — so a fully cached prompt attaches
+        ``len(tokens) - 1`` positions, copy-on-write forking the final
+        block (its last row gets rewritten by the re-run token, and shared
+        blocks are never written).
+        """
+        cache = self.prefix_cache
+        if (cache is None or seq.seen_tokens or seq.blocks or seq.pending
+                or len(tokens) < 2):
+            return 0
+        cache.stats.lookups += 1
+        blocks = cache.match_blocks(tokens)
+        usable = len(tokens) - 1
+        bs = self.block_size
+        cached = min(len(blocks) * bs, usable)
+        n_keep = -(-cached // bs)
+        blocks = blocks[:n_keep]
+        if cached <= 0:
+            cache.stats.misses += 1
+            return 0
+        cow = cached < n_keep * bs
+        self.allocator.acquire(blocks)
+        fresh: Optional[int] = None
+        if cow:
+            # Allocate the fork target with the match already acquired
+            # (refcount >= 2), so eviction under pressure can reclaim cold
+            # tree blocks but never the match itself.
+            try:
+                fresh = self._allocate(1)[0]
+            except RuntimeError:
+                # no room to fork the trimmed block: drop it from the match
+                self.allocator.free([blocks[-1]])
+                n_keep -= 1
+                cached = n_keep * bs
+                blocks = blocks[:n_keep]
+                cow = False
+                if cached <= 0:
+                    cache.stats.misses += 1
+                    return 0
+        seq.blocks = list(blocks)
+        seq.seen_tokens = cached
+        seq.tokens = [int(t) for t in tokens[:cached]]
+        seq.shared_blocks = n_keep
+        if cow:
+            self.kv_cache.copy_block(seq.blocks[-1], fresh)
+            self.allocator.free([seq.blocks[-1]])     # drop our shared ref
+            seq.blocks[-1] = fresh
+            seq.shared_blocks = n_keep - 1
+            # the tree already caches this content under the old block —
+            # re-registering the fork would diverge, so stop here
+            seq.register_stopped = True
+            cache.stats.cow_forks += 1
+        cache.stats.hits += 1
+        cache.stats.hit_tokens += cached
+        return cached
+
+    def register_prefix(self, seq: DSSequenceDescriptor) -> None:
+        """Register ``seq``'s newly completed full blocks into the radix
+        tree (called wherever ``seen_tokens`` advances).  No-op unless the
+        host knows the token values for every cached position."""
+        cache = self.prefix_cache
+        if cache is None or seq.register_stopped:
+            return
+        n_full = min(seq.seen_tokens // self.block_size, len(seq.blocks))
+        if n_full <= seq.shared_blocks:
+            return
+        if len(seq.tokens) != seq.seen_tokens:
+            seq.register_stopped = True   # values lost to the device
+            return
+        n, diverged = cache.insert(seq.tokens, seq.blocks,
+                                   start_block=seq.shared_blocks)
+        seq.shared_blocks += n
+        if diverged:
+            seq.register_stopped = True
+
+    def record_fed_tokens(self, seq: DSSequenceDescriptor, tokens) -> None:
+        """Append host-known token values the engine just wrote KV for
+        (keeps ``seq.tokens`` in lockstep with ``seen_tokens``)."""
+        if self.prefix_cache is None or seq.register_stopped:
+            return
+        seq.tokens.extend(int(t) for t in tokens)

@@ -47,10 +47,16 @@ def validate_ragged_metadata(seqs: List[DSSequenceDescriptor],
                              block_size: int) -> None:
     """Assert the invariants the paged kernel relies on (debug mode):
 
-    1. no two sequences own the same KV block (cross-sequence reads);
+    1. no two sequences own the same KV block — EXCEPT a block inside
+       BOTH sequences' shared prefix region (radix prefix cache: the
+       leading ``seq.shared_blocks`` blocks are read-only and
+       legitimately multi-referenced);
     2. every sequence's block table covers seen_tokens + chunk (a write
        past capacity would land in another sequence's block);
-    3. no sequence owns the trash block (pad writes target it).
+    3. no KV write may target a shared block (writes start at
+       ``seen_tokens``, which must clear the shared region — the state
+       manager copy-on-write forks before ever violating this);
+    4. no sequence owns the trash block (pad writes target it).
     """
     owned = {}
     for seq, chunk in zip(seqs, chunks):
@@ -65,19 +71,32 @@ def validate_ragged_metadata(seqs: List[DSSequenceDescriptor],
                 f"{len(seq.blocks) * block_size} positions but "
                 f"{need} are live — a KV write would spill into another "
                 f"sequence's block")
-        for b in seq.blocks:
+        shared_n = getattr(seq, "shared_blocks", 0)
+        if len(chunk) and seq.seen_tokens < shared_n * block_size:
+            raise RaggedMetadataError(
+                f"sequence {seq.uid}: write position {seq.seen_tokens} "
+                f"falls inside its shared prefix "
+                f"({shared_n} blocks) — a copy-on-write fork was skipped")
+        for j, b in enumerate(seq.blocks):
             if b == TRASH:
                 raise RaggedMetadataError(
                     f"sequence {seq.uid} owns the trash block {TRASH}")
+            shared = j < shared_n
             if b in owned:
-                raise RaggedMetadataError(
-                    f"KV block {b} owned by both sequence {owned[b]} and "
-                    f"sequence {seq.uid} — attention would read aliased "
-                    f"KV" if owned[b] != seq.uid else
-                    f"KV block {b} listed twice in sequence {seq.uid}'s "
-                    f"table — later positions would overwrite earlier "
-                    f"tokens' KV")
-            owned[b] = seq.uid
+                prev_uid, prev_shared = owned[b]
+                if prev_uid == seq.uid:
+                    raise RaggedMetadataError(
+                        f"KV block {b} listed twice in sequence "
+                        f"{seq.uid}'s table — later positions would "
+                        f"overwrite earlier tokens' KV")
+                if not (shared and prev_shared):
+                    raise RaggedMetadataError(
+                        f"KV block {b} owned by both sequence {prev_uid} "
+                        f"and sequence {seq.uid} outside their shared "
+                        f"prefix regions — attention would read aliased "
+                        f"KV")
+                continue
+            owned[b] = (seq.uid, shared)
 
 
 class RaggedBatchWrapper:

@@ -16,6 +16,22 @@ class DSSequenceDescriptor:
     # tokens awaiting scheduling (prompt remainder under SplitFuse)
     done: bool = False
 
+    # -- prefix-cache bookkeeping (all zero when caching is off) ------- #
+    #: token VALUES whose KV this sequence holds, positions [0, len);
+    #: kept in lockstep with ``seen_tokens`` so full blocks can be
+    #: registered in the radix tree.  Falls behind (and registration
+    #: stops) only when tokens are fed as device arrays whose values the
+    #: host never sees (``decode_step`` with device feedback).
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    #: leading blocks reachable through the radix tree (attached from the
+    #: cache or registered into it) — shared region: other sequences may
+    #: legitimately hold the same block ids, and no KV write may land
+    #: there (``shared_blocks * block_size <= seen_tokens`` always)
+    shared_blocks: int = 0
+    #: tree registration stopped permanently (content divergence with a
+    #: concurrently registered twin, or token values lost to the device)
+    register_stopped: bool = False
+
     @property
     def cur_allocated_blocks(self) -> int:
         return len(self.blocks)

@@ -17,10 +17,16 @@ Typical use::
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import (Request, RequestState,
                                            SamplingParams)
+from deepspeed_tpu.serving.router import (AdmissionRejectedError,
+                                          CacheAwareRouter, PriorityClass,
+                                          QuotaExceededError, Replica,
+                                          TenantQuota)
 from deepspeed_tpu.serving.sampler import sample_batch, sample_one
 from deepspeed_tpu.serving.scheduler import (ContinuousBatchScheduler,
                                              QueueFullError)
 
-__all__ = ["ContinuousBatchScheduler", "QueueFullError", "Request",
-           "RequestState", "SamplingParams", "ServingMetrics",
+__all__ = ["AdmissionRejectedError", "CacheAwareRouter",
+           "ContinuousBatchScheduler", "PriorityClass", "QueueFullError",
+           "QuotaExceededError", "Replica", "Request", "RequestState",
+           "SamplingParams", "ServingMetrics", "TenantQuota",
            "sample_batch", "sample_one"]

@@ -147,19 +147,24 @@ class ServingMetrics:
     # ------------------------------------------------------------------ #
     # Monitor fan-out
     # ------------------------------------------------------------------ #
-    def export(self, monitor=None,
-               now: Optional[float] = None) -> List[Tuple[str, float, float]]:
+    def export(self, monitor=None, now: Optional[float] = None,
+               extra: Optional[List[Tuple[str, float]]] = None,
+               ) -> List[Tuple[str, float, float]]:
         """Emit ``serving/*`` scalars through the monitor writers.
 
         The x value is wall-clock ``time.time()`` (float) — no fabricated
         step numbers; the writers persist it as-is (CSV), or as the
-        TensorBoard walltime axis.  Returns the event list (also when no
-        monitor is attached, for callers that fan out themselves).
+        TensorBoard walltime axis.  ``extra`` appends caller-supplied
+        ``(name, value)`` scalars (the scheduler's prefix-cache and
+        fast-tick telemetry) at the same x.  Returns the event list (also
+        when no monitor is attached, for callers that fan out themselves).
         """
         monitor = monitor if monitor is not None else self.monitor
         wall = time.time() if now is None else now
         events = [(f"serving/{k}", v, wall)
                   for k, v in self.snapshot().items()]
+        if extra:
+            events.extend((name, float(v), wall) for name, v in extra)
         if monitor is not None and getattr(monitor, "enabled", False):
             monitor.write_events(events)
         return events

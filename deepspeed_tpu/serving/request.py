@@ -112,6 +112,10 @@ class Request:
     finish_reason: Optional[str] = None
     #: admission order stamp (scheduler-assigned; preemption tie-break)
     admitted_at: int = -1
+    #: set by CacheAwareRouter at placement; None for requests submitted
+    #: directly to a scheduler
+    tenant: Optional[str] = None
+    replica: Optional[str] = None
 
     # -- per-request SLO accounting (wall-clock, time.monotonic) ------- #
     first_scheduled_time: Optional[float] = None

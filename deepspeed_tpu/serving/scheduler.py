@@ -54,8 +54,17 @@ class ContinuousBatchScheduler:
     def __init__(self, engine, monitor=None,
                  metrics: Optional[ServingMetrics] = None,
                  export_every: int = 0,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 fast_decode: bool = True):
         self.engine = engine
+        #: pure-decode ticks go through ``engine.decode_step`` — block
+        #: tables/positions stay device-resident across ticks and the
+        #: only host transfer is the sampled-token fetch, instead of a
+        #: full metadata pack+upload and an [S, vocab] logits download
+        #: per tick (the put()-path cost the bench's put_decode_step_ms
+        #: measures)
+        self.fast_decode = fast_decode and hasattr(engine, "decode_step")
+        self.fast_ticks = 0
         sm_cfg = engine.config.state_manager
         self.token_budget = sm_cfg.max_ragged_batch_size
         self.max_seqs = sm_cfg.max_ragged_sequence_count
@@ -73,8 +82,15 @@ class ContinuousBatchScheduler:
         self._running: Dict[int, Request] = {}
         self._preempted: List[Request] = []
         self._finished: List[Request] = []
+        #: uids of every non-terminal request — O(1) collision probes for
+        #: auto-uid allocation (here and in the fleet router)
+        self._live_uids: set = set()
         self._uid_counter = itertools.count(1)
         self._admit_counter = itertools.count()
+        #: summed _work() of queued+preempted requests — frozen while
+        #: parked (no feeding/decoding), maintained at the five bucket
+        #: transitions so backlog_tokens() never walks the queue
+        self._parked_backlog = 0
         self._tick = 0
         #: set by shutdown(): admission is closed for good
         self._shutting_down = False
@@ -134,13 +150,13 @@ class ContinuousBatchScheduler:
                 f"submit: prompt needs {prompt_blocks} KV blocks but the "
                 f"pool only has {sm.allocator.num_blocks - 1} usable")
         self._queued.append(request)
+        self._live_uids.add(request.uid)
+        self._parked_backlog += self._work(request)
         self.metrics.record_submit(request)
         return request
 
     def _is_tracked_uid(self, uid: int) -> bool:
-        return (uid in self._running
-                or any(r.uid == uid for r in self._queued)
-                or any(r.uid == uid for r in self._preempted))
+        return uid in self._live_uids
 
     # ------------------------------------------------------------------ #
     # State inspection
@@ -149,6 +165,21 @@ class ContinuousBatchScheduler:
     def num_pending(self) -> int:
         """Requests not yet in a terminal state."""
         return len(self._queued) + len(self._running) + len(self._preempted)
+
+    @staticmethod
+    def _work(req: Request) -> int:
+        """Outstanding tokens for one request: unfed history plus
+        remaining generation budget."""
+        return (req.remaining_feed
+                + max(req.sampling.max_new_tokens - len(req.generated), 0))
+
+    def backlog_tokens(self) -> int:
+        """Outstanding work in tokens across every non-terminal request
+        (the router's load signal).  O(max_seqs), not O(queue): parked
+        requests' contributions are frozen, so only the bounded running
+        set is walked."""
+        return self._parked_backlog + sum(
+            self._work(r) for r in self._running.values())
 
     @property
     def finished_requests(self) -> List[Request]:
@@ -183,15 +214,46 @@ class ContinuousBatchScheduler:
         for req in packed:
             if req.first_scheduled_time is None:
                 req.first_scheduled_time = now
-        logits = self.engine.put(uids, chunks, sync=True)
-        for req, chunk in zip(packed, chunks):
-            req.fed += len(chunk)
-
-        emitted = self._sample_and_advance(packed, logits)
+        if self.fast_decode and all(r.state is RequestState.DECODE
+                                    for r in packed):
+            emitted = self._fast_decode_tick(uids, chunks, packed)
+        else:
+            logits = self.engine.put(uids, chunks, sync=True)
+            for req, chunk in zip(packed, chunks):
+                req.fed += len(chunk)
+            emitted = self._sample_and_advance(packed, logits)
         self._tick += 1
         if self.export_every and self._tick % self.export_every == 0:
-            self.metrics.export()
+            self._export_metrics()
         return emitted
+
+    def _fast_decode_tick(self, uids, chunks, packed) -> List[Tuple[Request,
+                                                                    int]]:
+        """Steady-state decode tick: one ``decode_step`` dispatch against
+        the device-resident block tables.  All-greedy batches fetch only
+        the argmax'd token vector (a few bytes/request); any stochastic
+        request still needs its logits row on the host for the
+        (seed, uid, position)-keyed sampler."""
+        import jax
+
+        tokens = [c[0] for c in chunks]
+        n = len(uids)
+        self.fast_ticks += 1
+        if all(r.sampling.greedy for r in packed):
+            _, nxt = self.engine.decode_step(uids, tokens, greedy=True)
+            toks = [int(t) for t in
+                    np.asarray(jax.device_get(nxt))[:n]]
+            for req in packed:
+                req.fed += 1
+            return self._advance_emitted(packed, toks)
+        logits = self.engine.decode_step(uids, tokens)
+        rows = np.asarray(jax.device_get(logits), np.float32)[:n]
+        for req in packed:
+            req.fed += 1
+        tokens_out = sample_batch(rows, [r.sampling for r in packed],
+                                  [len(r.generated) for r in packed],
+                                  [r.uid for r in packed])
+        return self._advance_emitted(packed, tokens_out.tolist())
 
     # -- packing ------------------------------------------------------- #
     def _pack_decodes(self, uids, chunks, packed) -> None:
@@ -241,6 +303,32 @@ class ContinuousBatchScheduler:
                 continue
             if admitting:
                 self._admit(req)
+                # prefix-cache attach: (re)admission skips the prefill of
+                # any cached span — including a preempted request's own
+                # still-warm history, making recompute-resume nearly free
+                if hasattr(self.engine, "attach_prefix"):
+                    stats = getattr(self.engine, "prefix_cache_stats", None)
+                    snap = (None if stats is None else
+                            stats.attach_snapshot())
+                    hit = self.engine.attach_prefix(req.uid, req.history)
+                    if hit:
+                        req.fed = hit
+                        chunk = min(chunk, req.remaining_feed)
+                        # attaching pinned warm blocks that can_schedule
+                        # counted as evictable when the already-packed
+                        # chunks were validated — re-check the whole set
+                        # and defer this request if it no longer fits
+                        lens = [len(c) for c in chunks]
+                        if not self.engine.can_schedule(
+                                uids + [req.uid], lens + [chunk]):
+                            # the discarded attach saved nothing — its
+                            # prefill skip never ran, and the retry next
+                            # tick records the lookup/hit/fork again
+                            # (evicted_blocks stays: those frees happened)
+                            if snap is not None:
+                                stats.restore_attach(snap)
+                            self._preempt(req)
+                            break
             hist = req.history
             uids.append(req.uid)
             chunks.append(hist[req.fed:req.fed + chunk])
@@ -268,6 +356,7 @@ class ContinuousBatchScheduler:
             self._queued.remove(req)
         else:
             self._preempted.remove(req)
+        self._parked_backlog -= self._work(req)
         req.transition(RequestState.PREFILL)
         req.admitted_at = next(self._admit_counter)
         self._running[req.uid] = req
@@ -286,6 +375,7 @@ class ContinuousBatchScheduler:
         req.preemptions += 1
         req.transition(RequestState.PREEMPTED)
         self._preempted.append(req)
+        self._parked_backlog += self._work(req)
         self.metrics.record_preemption(req)
         logger.debug(f"serving: preempted request {req.uid} "
                      f"({len(req.generated)} tokens generated)")
@@ -296,10 +386,13 @@ class ContinuousBatchScheduler:
             del self._running[req.uid]
         if req in self._queued:
             self._queued.remove(req)
+            self._parked_backlog -= self._work(req)
         if req in self._preempted:
             self._preempted.remove(req)
+            self._parked_backlog -= self._work(req)
         req.finish_reason = reason
         req.transition(RequestState.FAILED)
+        self._live_uids.discard(req.uid)
         self._finished.append(req)
         self.metrics.record_finish(req)
         logger.warning(f"serving: request {req.uid} failed: {reason}")
@@ -331,12 +424,14 @@ class ContinuousBatchScheduler:
                 del self._running[req.uid]
             else:
                 self._preempted.remove(req)
+                self._parked_backlog -= self._work(req)
             if req.generated:
                 req.finish_reason = "length"
                 req.transition(RequestState.FINISHED)
             else:
                 req.finish_reason = "kv_capacity"
                 req.transition(RequestState.FAILED)
+            self._live_uids.discard(req.uid)
             self._finished.append(req)
             self.metrics.record_finish(req)
             logger.warning(
@@ -371,9 +466,13 @@ class ContinuousBatchScheduler:
         tokens = sample_batch(rows, [r.sampling for r in ready],
                               [len(r.generated) for r in ready],
                               [r.uid for r in ready])
+        return self._advance_emitted(ready, tokens.tolist())
+
+    def _advance_emitted(self, ready,
+                         tokens: List[int]) -> List[Tuple[Request, int]]:
         now = time.monotonic()
         emitted: List[Tuple[Request, int]] = []
-        for req, tok in zip(ready, tokens.tolist()):
+        for req, tok in zip(ready, tokens):
             req.emit(tok, now)
             emitted.append((req, tok))
             reason = req.should_stop()
@@ -390,12 +489,23 @@ class ContinuousBatchScheduler:
         del self._running[req.uid]
         req.finish_reason = reason
         req.transition(RequestState.FINISHED)
+        self._live_uids.discard(req.uid)
         self._finished.append(req)
         self.metrics.record_finish(req)
 
     # ------------------------------------------------------------------ #
     # Driving loops
     # ------------------------------------------------------------------ #
+    def _export_metrics(self) -> None:
+        """serving/* scalars plus prefix-cache and fast-tick telemetry."""
+        extra = [("serving/fast_decode_ticks", float(self.fast_ticks))]
+        pc = getattr(self.engine.state_manager, "prefix_cache", None) \
+            if hasattr(self.engine, "state_manager") else None
+        if pc is not None:
+            extra.extend((f"serving/prefix_{k}", v)
+                         for k, v in pc.stats.as_dict().items())
+        self.metrics.export(extra=extra)
+
     def run_until_idle(self, max_ticks: Optional[int] = None) -> List[Request]:
         """Step until every submitted request reaches a terminal state
         (or ``max_ticks``).  Returns all finished/failed requests so far."""
@@ -405,7 +515,7 @@ class ContinuousBatchScheduler:
                 break
             self.step()
             ticks += 1
-        self.metrics.export()
+        self._export_metrics()
         return self.finished_requests
 
     def run_with_arrivals(self, prompts, arrivals, sampling=None,
@@ -453,7 +563,7 @@ class ContinuousBatchScheduler:
                 "failing them with reason 'shutdown'")
             for req in leftovers:
                 self._fail(req, "shutdown")
-            self.metrics.export()
+            self._export_metrics()
         return idle
 
     def drain(self, deadline: float) -> bool:
@@ -465,5 +575,5 @@ class ContinuousBatchScheduler:
         while self.num_pending and time.monotonic() < end:
             self.step()
         if not self.num_pending:
-            self.metrics.export()
+            self._export_metrics()
         return self.num_pending == 0

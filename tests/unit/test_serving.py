@@ -388,6 +388,37 @@ def test_preemption_resume_greedy_parity(params):
     assert sm.free_blocks == sm.allocator.num_blocks - 1
 
 
+def test_backlog_tokens_incremental_counter_never_drifts(params):
+    """backlog_tokens() keeps an incremental counter for parked requests
+    (O(max_seqs) per probe — the router calls it every submit); it must
+    agree with a brute-force walk through every submit / admit / preempt
+    / resume / deadline-fail / finish transition."""
+    def brute(s):
+        return sum(s._work(r) for r in [*s._queued, *s._running.values(),
+                                        *s._preempted])
+
+    rng = np.random.default_rng(11)
+    eng = _engine(params, token_budget=32, block_size=8, max_context=48,
+                  max_seqs=4, num_blocks=7)   # KV-bound: forces preemption
+    sched = ContinuousBatchScheduler(eng)
+    reqs = []
+    for i in range(8):
+        prompt = rng.integers(0, CFG.vocab_size,
+                              size=(int(rng.integers(6, 16)),)).tolist()
+        reqs.append(sched.submit(
+            prompt, sampling=SamplingParams(max_new_tokens=8),
+            deadline_s=(1e-9 if i == 5 else None)))   # one deadline fail
+        assert sched.backlog_tokens() == brute(sched)
+    ticks = 0
+    while sched.num_pending:
+        sched.step()
+        assert sched.backlog_tokens() == brute(sched)
+        ticks += 1
+        assert ticks < 2000, "scheduler failed to converge"
+    assert sched.metrics.preemptions >= 1   # the interesting paths ran
+    assert sched.backlog_tokens() == 0
+
+
 def test_history_outgrowing_pool_truncates_not_livelocks(params):
     """A request whose history outgrows the ENTIRE KV pool must finish
     truncated (keeping its tokens), not spin in an infinite
@@ -674,13 +705,129 @@ def test_shutdown_deadline_expires_fails_pending(params):
 
 
 # --------------------------------------------------------------------- #
+# Device-resident decode tick (the put()-path host transfer killer)
+# --------------------------------------------------------------------- #
+def _spy_paths(engine):
+    """Record which engine entry point each tick used."""
+    paths = []
+    orig_put, orig_ds = engine.put, engine.decode_step
+
+    def put(uids, tokens, sync=True):
+        paths.append(("put", [len(t) for t in tokens]))
+        return orig_put(uids, tokens, sync=sync)
+
+    def ds(uids, tokens, greedy=False):
+        paths.append(("decode_step", len(uids)))
+        return orig_ds(uids, tokens, greedy=greedy)
+
+    engine.put, engine.decode_step = put, ds
+    return paths
+
+
+def test_fast_decode_tick_routes_through_decode_step(params):
+    """Steady-state greedy decode must NOT pack/upload ragged metadata
+    per tick: pure-DECODE ticks go through ``decode_step`` (device-
+    resident tables), mixed prefill ticks through ``put``."""
+    rng = np.random.default_rng(16)
+    prompts = [rng.integers(0, CFG.vocab_size, size=(6,)).tolist()
+               for _ in range(2)]
+    want = _greedy_reference(params, prompts, n_new=6)
+
+    eng = _engine(params)
+    paths = _spy_paths(eng)
+    sched = ContinuousBatchScheduler(eng)
+    reqs = [sched.submit(p, sampling=SamplingParams(max_new_tokens=6))
+            for p in prompts]
+    sched.run_until_idle()
+    for r, w in zip(reqs, want):
+        assert r.state is RequestState.FINISHED
+        assert r.generated == w               # device argmax == host argmax
+    kinds = [p[0] for p in paths]
+    assert kinds[0] == "put"                  # prefill tick
+    assert kinds.count("decode_step") == 5    # all-decode ticks
+    assert sched.fast_ticks == 5
+
+
+def test_fast_decode_opt_out_uses_put(params):
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(0, CFG.vocab_size, size=(6,)).tolist()
+    eng = _engine(params)
+    paths = _spy_paths(eng)
+    sched = ContinuousBatchScheduler(eng, fast_decode=False)
+    r = sched.submit(prompt, sampling=SamplingParams(max_new_tokens=4))
+    sched.run_until_idle()
+    assert r.state is RequestState.FINISHED
+    assert all(p[0] == "put" for p in paths)
+    assert sched.fast_ticks == 0
+
+
+def test_fast_decode_stochastic_matches_put_path(params):
+    """Non-greedy decode still fast-ticks (logits fetched for the
+    host sampler) and draws the same (seed, uid, position)-keyed tokens
+    as the put path."""
+    rng = np.random.default_rng(18)
+    prompt = rng.integers(0, CFG.vocab_size, size=(6,)).tolist()
+    sp = SamplingParams(greedy=False, temperature=0.8, top_k=8, seed=3,
+                        max_new_tokens=6)
+
+    def run(fast):
+        sched = ContinuousBatchScheduler(_engine(params), fast_decode=fast)
+        r = sched.submit(prompt, sampling=sp, uid=77)
+        sched.run_until_idle()
+        assert r.state is RequestState.FINISHED
+        return r.generated, sched.fast_ticks
+
+    toks_fast, fast_ticks = run(True)
+    toks_slow, slow_ticks = run(False)
+    assert toks_fast == toks_slow
+    assert fast_ticks == 5 and slow_ticks == 0
+
+
+def test_fast_decode_survives_preemption_and_mixed_ticks(params):
+    """Fast ticks interleaved with preempt/resume put ticks keep the
+    device-resident decode state coherent (greedy parity end to end)."""
+    rng = np.random.default_rng(19)
+    n_req, n_new = 6, 8
+    prompts = [rng.integers(0, CFG.vocab_size, size=(int(n),)).tolist()
+               for n in rng.integers(6, 16, size=n_req)]
+    want = _greedy_reference(params, prompts, n_new)
+    eng = _engine(params, token_budget=32, block_size=8, max_context=48,
+                  max_seqs=4, num_blocks=7)
+    sched = ContinuousBatchScheduler(eng)
+    reqs = []
+    tick = 0
+    while len(reqs) < n_req or sched.num_pending:
+        if len(reqs) < n_req and tick % 2 == 0:
+            reqs.append(sched.submit(
+                prompts[len(reqs)],
+                sampling=SamplingParams(max_new_tokens=n_new)))
+        sched.step()
+        tick += 1
+        assert tick < 2000
+    assert sched.metrics.preemptions >= 1
+    assert sched.fast_ticks >= 1
+    for r, w in zip(reqs, want):
+        assert r.generated == w, (r.uid, r.preemptions)
+
+
+# --------------------------------------------------------------------- #
 # The tier-1 smoke (tools/serving_smoke.py)
 # --------------------------------------------------------------------- #
-def test_serving_smoke_tool():
+def _load_smoke():
     path = pathlib.Path(__file__).resolve().parents[2] / "tools" / \
         "serving_smoke.py"
     spec = importlib.util.spec_from_file_location("serving_smoke", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    snap = mod.run_smoke()
+    return mod
+
+
+def test_serving_smoke_tool():
+    snap = _load_smoke().run_smoke()
     assert snap["finished"] == 8 and snap["preemptions"] >= 1
+
+
+def test_prefix_router_smoke_tool():
+    snap = _load_smoke().run_prefix_router_smoke()
+    assert snap["router_smoke"] == "ok"
+    assert snap["router_cache_hits"] >= 6

@@ -1,0 +1,359 @@
+"""Cache-aware router tests: longest-prefix placement, load balancing,
+per-tenant quotas, priority classes, SLO-aware admission, and an
+end-to-end multi-replica run over a shared-prefix workload."""
+
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
+from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.serving import (AdmissionRejectedError, CacheAwareRouter,
+                                   ContinuousBatchScheduler, PriorityClass,
+                                   QuotaExceededError, RequestState,
+                                   SamplingParams, TenantQuota)
+
+CFG = LlamaConfig.tiny(dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return LlamaForCausalLM(CFG).init(
+        jax.random.key(0), np.zeros((1, 4), np.int32))["params"]
+
+
+def _sched(params, token_budget=32, block_size=8, max_context=64,
+           max_seqs=4, num_blocks=None, prefix_cache=True):
+    cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": token_budget,
+                          "max_ragged_sequence_count": max_seqs,
+                          "max_context": max_context},
+        "kv_cache": {"block_size": block_size,
+                     "enable_prefix_cache": prefix_cache,
+                     **({"num_blocks": num_blocks}
+                        if num_blocks is not None else {})},
+    })
+    return ContinuousBatchScheduler(
+        InferenceEngineV2(RaggedLlama(CFG, block_size), params, cfg))
+
+
+def _router(params, n=2, **kw):
+    return CacheAwareRouter([_sched(params) for _ in range(n)], **kw)
+
+
+class _FakeCache:
+    """match_len stub: longest prefix against a stored token list."""
+
+    def __init__(self):
+        self.warm = []
+
+    def match_len(self, tokens):
+        n = 0
+        for a, b in zip(self.warm, tokens):
+            if a != b:
+                break
+            n += 1
+        return n
+
+
+class _FakeScheduler:
+    """Engine-free ContinuousBatchScheduler stand-in for router policy
+    tests: tracks queued requests and a metrics stub, never runs a model
+    (placement math, quotas, priority classes, and SLO admission are all
+    host-side router logic)."""
+
+    class _M:
+        def __init__(self, rate):
+            self._rate = rate
+
+        def overall_tokens_per_s(self):
+            return self._rate
+
+        def goodput_tokens_per_s(self):
+            return self._rate
+
+    def __init__(self, rate=0.0):
+        from deepspeed_tpu.serving.request import Request
+        self._Request = Request
+        self._queued = []
+        self._running = {}
+        self._preempted = []
+        self.metrics = self._M(rate)
+        self._uid = 100
+
+    def submit(self, prompt, sampling=None, priority=0, deadline_s=None,
+               on_token=None, uid=None):
+        self._uid += 1
+        req = self._Request(uid=uid or self._uid, prompt=list(prompt),
+                            sampling=sampling or SamplingParams(),
+                            priority=priority, deadline_s=deadline_s)
+        self._queued.append(req)
+        return req
+
+    def finish_all(self):
+        for r in self._queued:
+            r.state = RequestState.FAILED    # any terminal state
+        self._queued.clear()
+
+    def backlog_tokens(self):
+        total = 0
+        for r in [*self._queued, *self._running.values(), *self._preempted]:
+            total += r.remaining_feed
+            total += max(r.sampling.max_new_tokens - len(r.generated), 0)
+        return total
+
+    @property
+    def num_pending(self):
+        return len(self._queued)
+
+    def step(self):
+        return []
+
+
+def _fake_router(n=2, warm=None, rate=0.0, **kw):
+    from deepspeed_tpu.serving import Replica
+    scheds = [_FakeScheduler(rate=rate) for _ in range(n)]
+    for s in scheds:
+        s.engine = types.SimpleNamespace(
+            state_manager=types.SimpleNamespace(prefix_cache=_FakeCache()))
+    router = CacheAwareRouter(
+        [Replica(f"replica{i}", s) for i, s in enumerate(scheds)], **kw)
+    if warm is not None:
+        for name, tokens in warm.items():
+            i = [r.name for r in router.replicas].index(name)
+            scheds[i].engine.state_manager.prefix_cache.warm = list(tokens)
+    return router, scheds
+
+
+# --------------------------------------------------------------------- #
+# Placement
+# --------------------------------------------------------------------- #
+def test_router_routes_to_longest_prefix_replica(params):
+    router = _router(params, n=2)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, CFG.vocab_size, size=(20,)).tolist()
+    r1 = router.submit(prompt, sampling=SamplingParams(max_new_tokens=2))
+    router.run_until_idle()
+    warm = r1.replica
+    # same prompt again: must land on the replica holding the warm prefix
+    r2 = router.submit(prompt, sampling=SamplingParams(max_new_tokens=2))
+    assert r2.replica == warm
+    router.run_until_idle()
+    assert r2.generated == r1.generated
+    assert router.cache_hit_routed == 1 and router.cache_hit_tokens >= 16
+    # an unrelated prompt balances away from the (equally idle) replicas
+    cold = rng.integers(0, CFG.vocab_size, size=(12,)).tolist()
+    r3 = router.submit(cold, sampling=SamplingParams(max_new_tokens=2))
+    assert r3.replica in {rep.name for rep in router.replicas}
+    router.run_until_idle()
+
+
+def test_router_cold_requests_spread_by_load():
+    """With no cache affinity, placement follows load (outstanding
+    tokens), so concurrent cold submits spread across replicas."""
+    router, _ = _fake_router(n=2, load_weight=0.5)
+    rng = np.random.default_rng(1)
+    seen = set()
+    for i in range(4):
+        p = rng.integers(0, 256, size=(10,)).tolist()
+        seen.add(router.submit(
+            p, sampling=SamplingParams(max_new_tokens=2)).replica)
+    assert len(seen) == 2          # both replicas took cold work
+
+
+def test_router_assigns_fleet_unique_uids():
+    """Every scheduler's own uid counter starts at 1 — the router must
+    allocate fleet-global uids or requests placed on different replicas
+    collide and draw the same (seed, uid, position) sampling noise."""
+    router, _ = _fake_router(n=3, load_weight=0.5)
+    rng = np.random.default_rng(9)
+    reqs = [router.submit(rng.integers(0, 256, size=(10,)).tolist(),
+                          sampling=SamplingParams(max_new_tokens=2))
+            for _ in range(9)]
+    assert len({r.replica for r in reqs}) > 1       # placement did spread
+    assert len({r.uid for r in reqs}) == len(reqs)  # and uids stayed unique
+
+
+def test_router_affinity_yields_to_heavy_imbalance():
+    """Cache affinity is weighted against load: a warm replica buried in
+    work loses to an idle one (cache_weight vs load_weight composition)."""
+    prompt = list(range(16))
+    router, scheds = _fake_router(n=2, warm={"replica0": prompt},
+                                  cache_weight=1.0, load_weight=2.0)
+    r1 = router.submit(prompt, sampling=SamplingParams(max_new_tokens=2))
+    assert r1.replica == "replica0"           # affinity wins when idle
+    # pile queued work on the warm replica only
+    for _ in range(4):
+        scheds[0].submit(list(range(100, 124)),
+                         sampling=SamplingParams(max_new_tokens=16))
+    r2 = router.submit(prompt, sampling=SamplingParams(max_new_tokens=2))
+    assert r2.replica == "replica1"           # 16 warm tokens < 2.0*backlog
+
+
+# --------------------------------------------------------------------- #
+# Quotas
+# --------------------------------------------------------------------- #
+def test_router_tenant_quota_inflight(params):
+    router = _router(params, n=2,
+                     quotas={"acme": TenantQuota(max_inflight=2)})
+    rng = np.random.default_rng(3)
+
+    def p():
+        return rng.integers(0, CFG.vocab_size, size=(8,)).tolist()
+
+    router.submit(p(), tenant="acme")
+    router.submit(p(), tenant="acme")
+    with pytest.raises(QuotaExceededError, match="max_inflight=2"):
+        router.submit(p(), tenant="acme")
+    assert router.quota_rejects == 1
+    # other tenants are unaffected
+    router.submit(p(), tenant="other")
+    router.run_until_idle()
+    # quota frees up as requests finish
+    r = router.submit(p(), tenant="acme")
+    router.run_until_idle()
+    assert r.state is RequestState.FINISHED
+
+
+def test_router_tenant_quota_tokens(params):
+    router = _router(
+        params, n=1,
+        default_quota=TenantQuota(max_inflight_tokens=40))
+    rng = np.random.default_rng(4)
+    router.submit(rng.integers(0, 256, size=(16,)).tolist(),
+                  sampling=SamplingParams(max_new_tokens=16))
+    with pytest.raises(QuotaExceededError, match="max_inflight_tokens"):
+        router.submit(rng.integers(0, 256, size=(16,)).tolist(),
+                      sampling=SamplingParams(max_new_tokens=16))
+    router.run_until_idle()
+
+
+def test_tenant_quota_validation():
+    with pytest.raises(ValueError):
+        TenantQuota(max_inflight=0)
+    with pytest.raises(ValueError):
+        TenantQuota(max_inflight_tokens=-1)
+
+
+# --------------------------------------------------------------------- #
+# Priority classes + SLO admission
+# --------------------------------------------------------------------- #
+def test_router_priority_classes_map_to_scheduler_priority(params):
+    router = _router(params, n=1)
+    rng = np.random.default_rng(5)
+    hi = router.submit(rng.integers(0, 256, size=(6,)).tolist(),
+                       priority_class="interactive")
+    lo = router.submit(rng.integers(0, 256, size=(6,)).tolist(),
+                       priority_class="batch")
+    assert hi.priority > lo.priority
+    with pytest.raises(ValueError, match="unknown priority class"):
+        router.submit([1, 2], priority_class="platinum")
+    router.run_until_idle()
+
+
+def test_router_priority_class_custom_deadline(params):
+    router = _router(
+        params, n=1,
+        priority_classes={"rt": PriorityClass("rt", priority=5,
+                                              deadline_s=30.0)})
+    r = router.submit([1, 2, 3], priority_class="rt")
+    assert r.deadline_s == 30.0 and r.priority == 5
+    # explicit deadline wins over the class default
+    r2 = router.submit([4, 5, 6], priority_class="rt", deadline_s=60.0)
+    assert r2.deadline_s == 60.0
+    router.run_until_idle()
+
+
+def test_router_slo_admission_rejects_doomed_request(params):
+    router = _router(params, n=1, admission_tokens_per_s=10.0)
+    rng = np.random.default_rng(6)
+    # backlog: a long generation in flight
+    router.submit(rng.integers(0, 256, size=(8,)).tolist(),
+                  sampling=SamplingParams(max_new_tokens=16))
+    # backlog ~24 tokens at 10 tok/s ~ 2.4s > 1s deadline -> rejected
+    with pytest.raises(AdmissionRejectedError, match="deadline"):
+        router.submit(rng.integers(0, 256, size=(8,)).tolist(),
+                      deadline_s=1.0)
+    assert router.slo_rejects == 1
+    # no deadline -> admitted regardless of backlog
+    r = router.submit(rng.integers(0, 256, size=(8,)).tolist())
+    router.run_until_idle()
+    assert r.state is RequestState.FINISHED
+
+
+def test_router_slo_admission_skipped_without_estimate(params):
+    """No static rate and no throughput history: admit (no evidence to
+    reject on)."""
+    router = _router(params, n=1)
+    r = router.submit([1, 2, 3], deadline_s=120.0)
+    router.run_until_idle()
+    assert r.state is RequestState.FINISHED
+
+
+def test_router_slo_falls_back_to_viable_replica():
+    """A buried warm replica must not doom a deadline'd request another
+    (idle) replica could serve in time — admission tries replicas in
+    preference order and rejects only when every one blows the deadline."""
+    prompt = list(range(64))
+    router, scheds = _fake_router(n=2, warm={"replica0": prompt},
+                                  admission_tokens_per_s=10.0,
+                                  load_weight=0.01)
+    # bury the warm replica under a long generation (~240 backlog tokens)
+    scheds[0].submit(list(range(40)),
+                     sampling=SamplingParams(max_new_tokens=200))
+    # replica0: est wait ~24s > 10s; replica1 (cold, idle): 6.4s < 10s
+    r = router.submit(prompt, deadline_s=10.0)
+    assert r.replica == "replica1"
+    assert router.slo_rejects == 0
+    # a deadline no replica can meet is still rejected, with the
+    # preferred replica's verdict and one counted reject
+    with pytest.raises(AdmissionRejectedError, match="replica0"):
+        router.submit(prompt, deadline_s=2.0)
+    assert router.slo_rejects == 1
+
+
+# --------------------------------------------------------------------- #
+# End-to-end shared-prefix fleet run
+# --------------------------------------------------------------------- #
+def test_router_end_to_end_shared_prefix_fleet(params):
+    router = _router(params, n=2)
+    rng = np.random.default_rng(7)
+    pools = {f"t{i}": rng.integers(0, CFG.vocab_size,
+                                   size=(16,)).tolist()
+             for i in range(2)}
+    reqs = []
+    for i in range(8):
+        tenant = f"t{i % 2}"
+        prompt = pools[tenant] + rng.integers(
+            0, CFG.vocab_size, size=(4,)).tolist()
+        reqs.append(router.submit(
+            prompt, tenant=tenant,
+            sampling=SamplingParams(max_new_tokens=3)))
+        router.step()
+    router.run_until_idle()
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+    # fleet-global uids: no collisions even across replicas
+    assert len({r.uid for r in reqs}) == len(reqs)
+    # each tenant's pool went warm: later requests hit
+    assert router.cache_hit_routed >= 4
+    # tenant affinity: after warmup every t0 request sits on one replica
+    by_tenant = {}
+    for r in reqs[2:]:
+        by_tenant.setdefault(r.tenant, set()).add(r.replica)
+    assert all(len(v) == 1 for v in by_tenant.values()), by_tenant
+    snap = router.snapshot()
+    assert snap["cache_hit_routed"] == router.cache_hit_routed
+    assert sum(router.routed.values()) == 8
+
+
+def test_router_replica_name_validation(params):
+    with pytest.raises(ValueError, match="at least one"):
+        CacheAwareRouter([])
+    s = _sched(params)
+    router = CacheAwareRouter({"a": s})
+    assert router.replicas[0].name == "a"
