@@ -641,22 +641,191 @@ def measure_shared_prefix(n_requests: int = 64, tenants: int = 4,
     }
 
 
-def _cli_float(flag: str, default: float) -> float:
+def measure_fleet(n_replicas: int = 2, disaggregate: str | None = None,
+                  shared_prefix: bool = False,
+                  shared_prefix_ratio: float = 0.9,
+                  n_requests: int = 32, rate_rps: float = 16.0,
+                  prompt_len: int = 192, gen_tokens: int = 48,
+                  clients: int = 8, block_size: int = 128,
+                  tenants: int = 4, seed: int = 0):
+    """Fleet-mode serving benchmark: the full ``deepspeed_tpu.fleet``
+    stack — N replicas behind the cache-aware router — under the
+    existing Poisson workload (or the ``--shared-prefix`` per-tenant
+    workload), reporting fleet goodput, TTFT/TPOT percentiles, and (with
+    ``--disaggregate P:D``) the prefill→decode KV-handoff latency.
+
+    ``disaggregate="P:D"`` splits the fleet into P prefill and D decode
+    replicas with KV moving between the pools; colocated mode runs
+    ``n_replicas`` mixed replicas.  Every replica shares one params tree
+    (weights are read-only) but owns its engine, KV pool, and scheduler.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.fleet import ServingFleet
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.serving import (ContinuousBatchScheduler,
+                                       SamplingParams)
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                      intermediate_size=2048, num_hidden_layers=12,
+                      num_attention_heads=6, num_key_value_heads=2,
+                      max_position_embeddings=2048, dtype=jnp.bfloat16)
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.key(0), np.zeros((1, 8), np.int32))["params"]
+    params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+
+    rng = np.random.default_rng(seed)
+    shared_len = int(shared_prefix_ratio * prompt_len) if shared_prefix \
+        else 0
+    pools = {f"t{i}": rng.integers(0, cfg.vocab_size,
+                                   size=(shared_len,)).tolist()
+             for i in range(tenants)} if shared_prefix else {}
+
+    def make_prompt(i: int):
+        if not shared_prefix:
+            return ("default",
+                    rng.integers(0, cfg.vocab_size,
+                                 size=(prompt_len,)).tolist())
+        tenant = f"t{i % tenants}"
+        tail = rng.integers(0, cfg.vocab_size,
+                            size=(prompt_len - shared_len,)).tolist()
+        return tenant, pools[tenant] + tail
+
+    max_ctx = prompt_len + gen_tokens + 8
+    per_seq = -(-max_ctx // block_size)
+    num_blocks = clients * per_seq \
+        + tenants * (-(-prompt_len // block_size)) + 1
+
+    def factory(name: str) -> ContinuousBatchScheduler:
+        eng_cfg = RaggedInferenceEngineConfig.from_dict({
+            "state_manager": {"max_ragged_batch_size": 512,
+                              "max_ragged_sequence_count": clients,
+                              "max_context": max_ctx},
+            "kv_cache": {"block_size": block_size,
+                         "num_blocks": num_blocks,
+                         **({"enable_prefix_cache": True}
+                            if shared_prefix else {})},
+        })
+        return ContinuousBatchScheduler(
+            InferenceEngineV2(RaggedLlama(cfg, block_size), params,
+                              eng_cfg))
+
+    if disaggregate:
+        p, d = (int(x) for x in disaggregate.split(":"))
+        fleet = ServingFleet(factory, prefill_replicas=p,
+                             decode_replicas=d)
+        decode_replicas = d
+    else:
+        fleet = ServingFleet(factory, replicas=n_replicas)
+        decode_replicas = n_replicas
+
+    sampling = SamplingParams(greedy=True, max_new_tokens=gen_tokens)
+
+    # warmup: one small burst through every pool so the prefill buckets,
+    # decode programs, and (disaggregated) the KV-inject put tail are all
+    # compiled before the clock starts
+    n_warm = min(clients, 4)
+    for i in range(n_warm):
+        fleet.submit(make_prompt(i)[1], tenant="warm", sampling=sampling)
+    fleet.run_until_idle(max_ticks=20000)
+    warm_handoffs = len(fleet.metrics.handoff_latency_s)
+
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps,
+                                         size=n_requests))
+    frs = []
+    total_prompt_tokens = 0
+    t0 = time.perf_counter()
+    while len(frs) < n_requests or fleet.num_pending:
+        now = time.perf_counter() - t0
+        while len(frs) < n_requests and arrivals[len(frs)] <= now:
+            tenant, prompt = make_prompt(len(frs))
+            total_prompt_tokens += len(prompt)
+            frs.append(fleet.submit(prompt, tenant=tenant,
+                                    sampling=sampling))
+        if fleet.num_pending:
+            fleet.step()
+        elif len(frs) < n_requests:
+            time.sleep(min(arrivals[len(frs)] - now, 0.005))
+    wall = time.perf_counter() - t0
+
+    bad = [fr for fr in frs if fr.state != "finished"]
+    assert not bad, [(fr.uid, fr.state, fr.finish_reason) for fr in bad]
+    tokens = sum(len(fr.tokens) for fr in frs)
+    goodput = tokens / wall
+    ttft_ms = [1000 * fr.ttft for fr in frs if fr.ttft is not None]
+    tpot_ms = [1000 * fr.tpot for fr in frs if fr.tpot is not None]
+    lat = list(fleet.metrics.handoff_latency_s)[warm_handoffs:]
+
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    roofline_tok_s = decode_replicas * clients * \
+        hbm_bandwidth_bytes_per_s() / (n_params * 2)
+    snap = fleet.snapshot()
+    pct = lambda v, q: (float(np.percentile(v, q)) if v else 0.0)  # noqa: E731
+
+    return {
+        "metric": "serving_fleet_goodput_tokens_per_sec",
+        "value": round(goodput, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(goodput / (0.5 * roofline_tok_s), 4),
+        "extra": {
+            "replicas": int(snap["fleet/replicas"]),
+            "mode": (f"disaggregated {disaggregate}" if disaggregate
+                     else f"colocated x{n_replicas}"),
+            "shared_prefix": bool(shared_prefix),
+            "n_requests": n_requests,
+            "rate_rps": rate_rps,
+            "prompt_len": prompt_len,
+            "gen_tokens": gen_tokens,
+            "p50_ttft_ms": round(pct(ttft_ms, 50), 2),
+            "p95_ttft_ms": round(pct(ttft_ms, 95), 2),
+            "p50_tpot_ms": round(pct(tpot_ms, 50), 3),
+            "p95_tpot_ms": round(pct(tpot_ms, 95), 3),
+            "handoffs": int(snap["fleet/handoffs"]),
+            "p50_handoff_ms": round(1000 * pct(lat, 50), 3),
+            "p95_handoff_ms": round(1000 * pct(lat, 95), 3),
+            "sched_preemptions": int(snap["fleet/preemptions"]),
+            "wall_s": round(wall, 2),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
+def _cli_str(flag: str, default):
     """Parse ``--flag=X`` or ``--flag X`` from argv."""
     for i, a in enumerate(sys.argv):
         if a.startswith(flag + "="):
-            return float(a.split("=", 1)[1])
+            return a.split("=", 1)[1]
         if a == flag and i + 1 < len(sys.argv):
-            return float(sys.argv[i + 1])
+            return sys.argv[i + 1]
     return default
+
+
+def _cli_float(flag: str, default: float) -> float:
+    val = _cli_str(flag, None)
+    return default if val is None else float(val)
 
 
 if __name__ == "__main__":
     _shared_prefix = "--shared-prefix" in sys.argv or any(
         a.startswith("--shared-prefix-ratio") for a in sys.argv)
+    _fleet = any(a == "--fleet" or a.startswith("--fleet=")
+                 for a in sys.argv)
+    _disagg = _cli_str("--disaggregate", None)
+    if _disagg is not None and not _fleet:
+        raise SystemExit("bench_serving: --disaggregate P:D requires "
+                         "--fleet N")
+    # --shared-prefix composes with --fleet (it selects the fleet's
+    # workload); every other pairing is a conflict
     _modes = [f for f, on in [("--7b", "--7b" in sys.argv),
                               ("--scheduler", "--scheduler" in sys.argv),
-                              ("--shared-prefix", _shared_prefix)] if on]
+                              ("--fleet", _fleet),
+                              ("--shared-prefix",
+                               _shared_prefix and not _fleet)] if on]
     if len(_modes) > 1:
         raise SystemExit(f"bench_serving: pick one mode, got {_modes}")
     try:
@@ -664,6 +833,17 @@ if __name__ == "__main__":
             print(json.dumps(measure_7b()))
         elif "--scheduler" in sys.argv:
             print(json.dumps(measure_scheduler()))
+        elif _fleet:
+            try:
+                _n_replicas = int(_cli_float("--fleet", 2))
+            except ValueError:
+                _n_replicas = 2      # bare "--fleet" next to another flag
+            print(json.dumps(measure_fleet(
+                n_replicas=_n_replicas,
+                disaggregate=_disagg,
+                shared_prefix=_shared_prefix,
+                shared_prefix_ratio=_cli_float("--shared-prefix-ratio",
+                                               0.9))))
         elif _shared_prefix:
             print(json.dumps(measure_shared_prefix(
                 shared_prefix_ratio=_cli_float("--shared-prefix-ratio",
@@ -678,6 +858,8 @@ if __name__ == "__main__":
                   if "--7b" in sys.argv
                   else "serving_scheduler_goodput_tokens_per_sec"
                   if "--scheduler" in sys.argv
+                  else "serving_fleet_goodput_tokens_per_sec"
+                  if _fleet
                   else "serving_shared_prefix_cache"
                   if _shared_prefix
                   else "fastgen_decode_tokens_per_sec_125m")

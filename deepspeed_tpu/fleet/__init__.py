@@ -1,0 +1,35 @@
+"""Production serving fleet: supervised replicas, zero-loss failure
+replay, rolling drain-then-restart upgrades, queue-depth elasticity, and
+disaggregated prefill/decode pools with KV handoff.
+
+Typical use::
+
+    from deepspeed_tpu.fleet import ServingFleet
+
+    fleet = ServingFleet(make_scheduler, replicas=4)
+    req = fleet.submit(prompt_tokens, tenant="acme",
+                       priority_class="interactive")
+    fleet.run_until_idle()
+    print(req.generated, req.ttft, fleet.snapshot())
+
+Disaggregated (separate prefill and decode pools, KV moves between
+them)::
+
+    fleet = ServingFleet(make_scheduler, prefill_replicas=1,
+                         decode_replicas=2)
+
+Process-separated replicas under per-replica ``JobSupervisor``s live in
+:mod:`deepspeed_tpu.fleet.worker` (:class:`FleetFrontEnd` /
+:func:`run_replica_worker`); ``tools/fleet_smoke.py`` SIGKILLs one
+mid-decode and proves zero requests are lost.
+"""
+
+from deepspeed_tpu.fleet.elastic import FleetAutoscaler
+from deepspeed_tpu.fleet.fleet import (FleetRequest, SchedulerFactory,
+                                       ServingFleet)
+from deepspeed_tpu.fleet.metrics import FleetMetrics
+from deepspeed_tpu.fleet.worker import FleetFrontEnd, run_replica_worker
+
+__all__ = ["FleetAutoscaler", "FleetFrontEnd", "FleetMetrics",
+           "FleetRequest", "SchedulerFactory", "ServingFleet",
+           "run_replica_worker"]

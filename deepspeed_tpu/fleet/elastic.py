@@ -1,0 +1,145 @@
+"""Elastic sizing of the serving replica set.
+
+The training side already has batch algebra (``elasticity.
+compute_elastic_config``) deciding which world sizes preserve
+convergence; serving reuses it as the "which replica counts are legal"
+oracle (a replica may itself span ``slots_per_replica`` devices) and adds
+the load policy on top:
+
+* **scale up** when the per-replica token backlog has exceeded
+  ``scale_up_backlog`` for ``patience`` consecutive observations — queued
+  work is outrunning the fleet;
+* **scale down** when it has stayed under ``scale_down_backlog`` for
+  ``patience`` observations AND the fleet is above ``min_replicas`` —
+  capacity is idling;
+* **churn bound** — scale moves draw from a sliding-window
+  :class:`~deepspeed_tpu.resilience.supervisor.RestartBudget`, so an
+  oscillating load cannot thrash replicas up and down faster than the
+  window admits (each move costs an engine spawn or a drain).
+
+The policy is a pure function of the observed series (``now`` is
+injectable), so tests drive it with synthetic queue-depth traces.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from deepspeed_tpu.resilience.supervisor import RestartBudget
+from deepspeed_tpu.utils.logging import logger
+
+
+class FleetAutoscaler:
+    """Queue-depth/goodput-driven replica-count policy (see module doc)."""
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 scale_up_backlog: float = 512.0,
+                 scale_down_backlog: float = 64.0,
+                 patience: int = 3,
+                 max_moves: int = 4, move_window_s: float = 60.0,
+                 elastic_config: Optional[dict] = None,
+                 slots_per_replica: int = 1,
+                 pool: Optional[str] = None):
+        if not 1 <= min_replicas <= max_replicas:
+            raise ValueError(
+                f"invalid replica bounds: min={min_replicas} "
+                f"max={max_replicas}")
+        if scale_down_backlog >= scale_up_backlog:
+            raise ValueError(
+                f"scale_down_backlog ({scale_down_backlog}) must sit below "
+                f"scale_up_backlog ({scale_up_backlog}) — equal thresholds "
+                "oscillate")
+        if patience < 1:
+            raise ValueError("patience must be >= 1")
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.scale_up_backlog = float(scale_up_backlog)
+        self.scale_down_backlog = float(scale_down_backlog)
+        self.patience = patience
+        self.budget = RestartBudget(max_moves, move_window_s)
+        self.elastic_config = elastic_config
+        self.slots_per_replica = slots_per_replica
+        #: which pool's queue depth is THE scale signal.  None sums every
+        #: pool — correct only when the scaled pool is the whole fleet;
+        #: a disaggregated fleet must scope to the pool it resizes, or a
+        #: prefill backlog (divided by the decode count) would spawn
+        #: decode replicas with zero work.  ServingFleet fills this in.
+        self.pool = pool
+        self._over = 0      # consecutive observations above the up bar
+        self._under = 0     # consecutive observations below the down bar
+        self.decisions = 0
+        self.held_by_budget = 0
+
+    # ------------------------------------------------------------------ #
+    def _admits(self, n: int) -> bool:
+        """Is ``n`` replicas a legal world under the elastic config?"""
+        if self.elastic_config is None:
+            return True
+        from deepspeed_tpu.elasticity import (
+            ElasticityError, ElasticityIncompatibleWorldSize,
+            compute_elastic_config)
+        from deepspeed_tpu.version import __version__
+
+        try:
+            compute_elastic_config(self.elastic_config, __version__,
+                                   world_size=n * self.slots_per_replica)
+        except ElasticityIncompatibleWorldSize:
+            return False
+        except ElasticityError as e:
+            logger.error(f"autoscaler: elastic config rejected: {e}")
+            return False
+        return True
+
+    def _snap(self, n: int, direction: int) -> int:
+        """Nearest legal replica count moving in ``direction`` from ``n``
+        (inclusive), within [min_replicas, max_replicas]; 0 if none."""
+        step = 1 if direction > 0 else -1
+        m = n
+        while self.min_replicas <= m <= self.max_replicas:
+            if self._admits(m):
+                return m
+            m += step
+        return 0
+
+    # ------------------------------------------------------------------ #
+    def observe(self, snapshot: Dict[str, float], n_replicas: int,
+                now: Optional[float] = None) -> int:
+        """Feed one fleet-metrics observation; returns the TARGET replica
+        count (== ``n_replicas`` for "hold").  ``snapshot`` is
+        :meth:`FleetMetrics.snapshot` output — per-pool queue depths
+        (token backlog) are summed and normalised per replica."""
+        self.decisions += 1
+        now = time.monotonic() if now is None else now
+        if self.pool is not None:
+            backlog = snapshot.get(f"fleet/queue_depth_{self.pool}", 0.0)
+        else:
+            backlog = sum(v for k, v in snapshot.items()
+                          if k.startswith("fleet/queue_depth_"))
+        per_replica = backlog / max(n_replicas, 1)
+        if per_replica > self.scale_up_backlog:
+            self._over += 1
+            self._under = 0
+        elif per_replica < self.scale_down_backlog:
+            self._under += 1
+            self._over = 0
+        else:
+            self._over = self._under = 0
+
+        target = n_replicas
+        if self._over >= self.patience and n_replicas < self.max_replicas:
+            target = self._snap(n_replicas + 1, +1) or n_replicas
+        elif self._under >= self.patience and n_replicas > self.min_replicas:
+            # downsizing with work still in flight is safe: the fleet
+            # drains the victim with handoff, so requests migrate, not die
+            target = self._snap(n_replicas - 1, -1) or n_replicas
+        if target == n_replicas:
+            return n_replicas
+        if self.budget.exhausted(now):
+            self.held_by_budget += 1
+            return n_replicas
+        self.budget.record(now)
+        self._over = self._under = 0
+        logger.info(f"autoscaler: {n_replicas} -> {target} replicas "
+                    f"(backlog/replica {per_replica:.0f} tokens)")
+        return target

@@ -1,0 +1,550 @@
+"""`ServingFleet` — N serving replicas behind one cache-aware front door,
+run as a single supervised, restartable, disaggregatable system.
+
+This is the integration layer the last three subsystems were built for:
+
+* **replicas** — each a :class:`ContinuousBatchScheduler` over its own
+  :class:`InferenceEngineV2` (spawned by a caller-supplied factory,
+  typically from serialized/checkpointed engine state so respawn is one
+  sequential read, not a cold HF load);
+* **front door** — :class:`CacheAwareRouter` places traffic by warm-prefix
+  affinity and load, under tenant quotas / priority classes / SLO
+  admission;
+* **zero-loss failure handling** — the fleet journals every request
+  (prompt, sampling seed, every token delivered).  When a replica dies
+  (:meth:`kill_replica` in-process; SIGKILL against real subprocess
+  workers in :mod:`deepspeed_tpu.fleet.worker`), its in-flight requests
+  are rebuilt from the journal and re-routed: the replay request carries
+  the already-delivered tokens as its ``generated`` prefix, re-prefills
+  ``prompt + prefix`` (warm radix blocks re-attach where available), and
+  the ``(seed, uid, position)``-keyed sampler makes the continuation the
+  exact stream an uninterrupted run would have produced;
+* **rolling restarts** — :meth:`rolling_restart` drains one replica at a
+  time with ``shutdown(handoff=True)``; drained-but-unfinished requests
+  migrate to the rest of the fleet instead of failing, and admission
+  stays open throughout (the router skips draining replicas);
+* **elasticity** — a :class:`FleetAutoscaler` observes the ``fleet/*``
+  queue-depth/goodput telemetry and resizes the replica set; downsizing
+  drains the victim with handoff, so scale-down migrates work, never
+  drops it;
+* **disaggregated prefill/decode** — with ``prefill_replicas`` /
+  ``decode_replicas`` the pools split: new requests prefill on the
+  prefill pool; the tick a prefill completes (first token emitted) the
+  request is extracted WITH its device KV
+  (``engine.flush_to_host(include_kv=True)``) and resumed on a decode
+  replica (``engine.resume(kv_state=...)``) — DeepSpeed-FastGen's
+  SplitFuse taken to its disaggregated conclusion: a long prefill
+  saturates a prefill replica's tick, never the decode pool's, and the
+  migrated KV makes decode tokens bit-identical to the colocated path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from deepspeed_tpu.fleet.elastic import FleetAutoscaler
+from deepspeed_tpu.fleet.metrics import FleetMetrics
+from deepspeed_tpu.serving.request import (Request, RequestSnapshot,
+                                           RequestState, SamplingParams)
+from deepspeed_tpu.serving.router import CacheAwareRouter, Replica
+from deepspeed_tpu.serving.scheduler import ContinuousBatchScheduler
+from deepspeed_tpu.utils.logging import logger
+
+#: scheduler_factory(name) -> a fresh ContinuousBatchScheduler (engine
+#: included).  Called at fleet construction, replica respawn, rolling
+#: restart, and elastic scale-up — build it over serialized engine state
+#: (InferenceEngineV2.load_serialized) so a respawn is cheap.
+SchedulerFactory = Callable[[str], ContinuousBatchScheduler]
+
+
+@dataclasses.dataclass
+class FleetRequest:
+    """Client-facing handle: survives replica deaths, handoffs, and
+    rolling restarts (the scheduler-level :class:`Request` object may be
+    replaced several times underneath it)."""
+
+    uid: int
+    prompt: List[int]
+    sampling: SamplingParams
+    tenant: str = "default"
+    priority: int = 0
+    deadline_s: Optional[float] = None
+    #: every token delivered to the client, across all incarnations
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    state: str = "live"                  # live | finished | failed
+    finish_reason: Optional[str] = None
+    arrival: float = dataclasses.field(default_factory=time.monotonic)
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    #: replica trail: where the request has run, in order
+    replicas: List[str] = dataclasses.field(default_factory=list)
+    replays: int = 0                     # crash-replay count
+    handoffs: int = 0                    # planned migrations
+    on_token: Optional[Callable] = None  # client streaming hook
+
+    @property
+    def done(self) -> bool:
+        return self.state != "live"
+
+    @property
+    def generated(self) -> List[int]:
+        return list(self.tokens)
+
+    @property
+    def replica(self) -> Optional[str]:
+        return self.replicas[-1] if self.replicas else None
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.arrival
+
+    @property
+    def tpot(self) -> Optional[float]:
+        if self.first_token_time is None or self.finish_time is None \
+                or len(self.tokens) < 2:
+            return None
+        return ((self.finish_time - self.first_token_time)
+                / (len(self.tokens) - 1))
+
+    def snapshot(self) -> RequestSnapshot:
+        """Replay state rebuilt from the FLEET's journal — exactly what
+        survives a replica's death (the dead scheduler's memory does
+        not)."""
+        remaining = None
+        if self.deadline_s is not None:
+            remaining = max(
+                self.deadline_s - (time.monotonic() - self.arrival), 1e-3)
+        return RequestSnapshot(
+            uid=self.uid, prompt=list(self.prompt),
+            generated=list(self.tokens),
+            sampling=dataclasses.asdict(self.sampling),
+            priority=self.priority, deadline_s=remaining,
+            tenant=self.tenant)
+
+
+class ServingFleet:
+    """See module doc.  Colocated mode: ``replicas`` mixed
+    prefill+decode workers.  Disaggregated mode: ``prefill_replicas`` /
+    ``decode_replicas`` split pools with KV handoff between them."""
+
+    def __init__(self, scheduler_factory: SchedulerFactory,
+                 replicas: int = 2, *,
+                 prefill_replicas: int = 0, decode_replicas: int = 0,
+                 router_kwargs: Optional[dict] = None,
+                 autoscaler: Optional[FleetAutoscaler] = None,
+                 autoscale_every: int = 8,
+                 metrics: Optional[FleetMetrics] = None,
+                 monitor=None,
+                 time_handoffs: bool = True,
+                 keep_finished: Optional[int] = None):
+        if (prefill_replicas > 0) != (decode_replicas > 0):
+            raise ValueError(
+                "disaggregation needs BOTH prefill_replicas and "
+                "decode_replicas > 0")
+        self.factory = scheduler_factory
+        self.disaggregated = prefill_replicas > 0
+        self.metrics = metrics if metrics is not None \
+            else FleetMetrics(monitor)
+        self.autoscaler = autoscaler
+        if autoscaler is not None and autoscaler.pool is None:
+            # the scale signal must be the pool being resized
+            autoscaler.pool = "decode" if self.disaggregated else "mixed"
+        self.autoscale_every = autoscale_every
+        router_kwargs = dict(router_kwargs or {})
+        self._name_counters: Dict[str, itertools.count] = {}
+        if self.disaggregated:
+            pre = [self._next_name("prefill")
+                   for _ in range(prefill_replicas)]
+            self.router = CacheAwareRouter(
+                {n: scheduler_factory(n) for n in pre}, **router_kwargs)
+            dec = [self._next_name("decode")
+                   for _ in range(decode_replicas)]
+            self.decode_router = CacheAwareRouter(
+                {n: scheduler_factory(n) for n in dec})
+        else:
+            names = [self._next_name("replica") for _ in range(replicas)]
+            self.router = CacheAwareRouter(
+                {n: scheduler_factory(n) for n in names}, **router_kwargs)
+            self.decode_router = None
+        #: fleet-global uid allocation: requests may live on ANY pool's
+        #: replicas, so neither router's own scan is wide enough
+        self._uid_counter = itertools.count(1)
+        self._requests: Dict[int, FleetRequest] = {}
+        self._collected: set = set()
+        #: live (not-done) request count — O(1) num_pending per tick
+        self._n_live = 0
+        #: per-scheduler read offset into its _finished list, keyed by
+        #: scheduler identity (rebuilt each collect, so replaced
+        #: schedulers drop out) — collection is O(new finishes), not
+        #: O(lifetime finishes)
+        self._fin_offset: Dict[int, int] = {}
+        #: journal retention: None keeps every FleetRequest (tests,
+        #: benches); an int bounds host memory on long-running fleets by
+        #: dropping the oldest finished entries past that count
+        self.keep_finished = keep_finished
+        self._finished_order: List[int] = []
+        #: detached snapshots that could not be placed anywhere yet —
+        #: retried every tick, so a transiently-full fleet parks work
+        #: instead of losing it
+        self._parked: List[RequestSnapshot] = []
+        #: sample per-handoff latency with a device sync on the target
+        #: pool (honest KV-resident→KV-resident numbers for the bench);
+        #: disable on latency-critical deployments to keep the decode
+        #: pool's dispatch pipeline fully async
+        self.time_handoffs = time_handoffs
+        self._tick = 0
+
+    # ------------------------------------------------------------------ #
+    # Topology
+    # ------------------------------------------------------------------ #
+    def _next_name(self, prefix: str) -> str:
+        ctr = self._name_counters.setdefault(prefix, itertools.count())
+        return f"{prefix}{next(ctr)}"
+
+    def pool_members(self) -> Iterable[Tuple[str, Replica]]:
+        """(pool name, replica) for every live replica — reads the
+        routers' live lists, so elastic moves are reflected instantly."""
+        if self.disaggregated:
+            for rep in self.router.replicas:
+                yield "prefill", rep
+            for rep in self.decode_router.replicas:
+                yield "decode", rep
+        else:
+            for rep in self.router.replicas:
+                yield "mixed", rep
+
+    def _find(self, name: str) -> Tuple[CacheAwareRouter, Replica]:
+        for pool, rep in self.pool_members():
+            if rep.name == name:
+                return (self.decode_router if pool == "decode"
+                        else self.router), rep
+        raise ValueError(f"fleet: unknown replica {name!r}")
+
+    @property
+    def replica_names(self) -> List[str]:
+        return [rep.name for _, rep in self.pool_members()]
+
+    @property
+    def num_pending(self) -> int:
+        return self._n_live
+
+    @property
+    def requests(self) -> List[FleetRequest]:
+        return list(self._requests.values())
+
+    # ------------------------------------------------------------------ #
+    # Submission
+    # ------------------------------------------------------------------ #
+    def _hook(self, fr: FleetRequest):
+        def on_token(req: Request, tok: int) -> None:
+            fr.tokens.append(int(tok))
+            if fr.first_token_time is None:
+                fr.first_token_time = time.monotonic()
+            if fr.on_token is not None:
+                fr.on_token(fr, int(tok))
+        return on_token
+
+    def submit(self, prompt, *, tenant: str = "default",
+               priority_class: Optional[str] = None,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               sampling: Optional[SamplingParams] = None,
+               on_token=None) -> FleetRequest:
+        """Admit one request through the front door (quota / priority /
+        SLO gates, cache-affine placement).  Returns the durable
+        :class:`FleetRequest` handle; ``on_token(fleet_request, token)``
+        streams every token across replica incarnations."""
+        uid = next(self._uid_counter)
+        fr = FleetRequest(uid=uid, prompt=[int(t) for t in prompt],
+                          sampling=sampling or SamplingParams(),
+                          tenant=tenant, on_token=on_token)
+        req = self.router.submit(
+            fr.prompt, tenant=tenant, priority_class=priority_class,
+            priority=priority, deadline_s=deadline_s,
+            sampling=fr.sampling, on_token=self._hook(fr), uid=uid)
+        fr.priority = req.priority
+        fr.deadline_s = req.deadline_s
+        fr.replicas.append(req.replica)
+        self._requests[uid] = fr
+        self._n_live += 1
+        return fr
+
+    # ------------------------------------------------------------------ #
+    # The fleet tick
+    # ------------------------------------------------------------------ #
+    def step(self) -> int:
+        """One tick across the whole fleet: every replica with pending
+        work runs one scheduler tick, completed prefills migrate to the
+        decode pool (disaggregated mode), finishes are collected into the
+        journal, and the autoscaler gets its observation.  Returns the
+        number of tokens emitted fleet-wide this tick."""
+        emitted = 0
+        if self._parked:
+            parked, self._parked = self._parked, []
+            for snap in parked:
+                self._place(snap)
+        for _, rep in list(self.pool_members()):
+            if rep.num_pending:
+                emitted += len(rep.step())
+        if self.disaggregated:
+            self._pump_handoffs()
+        self._collect()
+        self._tick += 1
+        if self.autoscaler is not None \
+                and self._tick % self.autoscale_every == 0:
+            self._autoscale()
+        return emitted
+
+    def run_until_idle(self, max_ticks: Optional[int] = None
+                       ) -> List[FleetRequest]:
+        ticks = 0
+        while self.num_pending:
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+            self.step()
+            ticks += 1
+        return self.requests
+
+    def _place(self, snap: RequestSnapshot) -> Optional[Request]:
+        """Place a detached snapshot on the admission router (recompute
+        replay).  On failure the snapshot is PARKED and retried next tick
+        — a transiently-full or mid-upgrade fleet delays the request, it
+        never loses it."""
+        fr = self._requests.get(snap.uid)
+        try:
+            req = self.router.resubmit(
+                snap, on_token=self._hook(fr) if fr else None)
+        except Exception as e:  # noqa: BLE001 — zero-loss is the contract
+            logger.warning(
+                f"fleet: no replica could take request {snap.uid} right "
+                f"now ({e}) — parked for retry next tick")
+            self._parked.append(snap)
+            return None
+        if fr is not None:
+            fr.replicas.append(req.replica)
+        return req
+
+    # -- disaggregated prefill -> decode migration ---------------------- #
+    def _pump_handoffs(self) -> None:
+        """Move every request that finished prefilling (entered DECODE)
+        off the prefill pool, device KV in hand, onto a decode replica.
+        The prefill replica's next tick is pure prefill again — long
+        prompts never stall the decode pool's tick."""
+        import jax
+
+        for rep in list(self.router.replicas):
+            for uid in list(rep.scheduler.running_decode_uids):
+                fr = self._requests.get(uid)
+                t0 = time.perf_counter()
+                snap, kv = rep.scheduler.extract_for_handoff(
+                    uid, include_kv=True)
+                if fr is not None:
+                    fr.handoffs += 1
+                try:
+                    req = self.decode_router.resubmit(
+                        snap, kv_state=kv,
+                        on_token=self._hook(fr) if fr else None)
+                except Exception:
+                    logger.exception(
+                        f"fleet: decode pool rejected handed-off request "
+                        f"{uid} — recompute-replaying via the front door")
+                    # no latency sample: this was NOT a KV handoff
+                    self.metrics.record_handoff()
+                    self._place(snap)
+                    continue
+                if self.time_handoffs:
+                    # honest latency: the KV gather (extract) device_gets,
+                    # but the scatter on the target is async — block on
+                    # the target pool so the bracket covers
+                    # KV-resident-to-KV-resident
+                    target = self._find(req.replica)[1].scheduler
+                    jax.block_until_ready(jax.tree_util.tree_leaves(
+                        target.engine.state_manager.kv_cache.cache))
+                    self.metrics.record_handoff(time.perf_counter() - t0)
+                else:
+                    self.metrics.record_handoff()
+                if fr is not None:
+                    fr.replicas.append(req.replica)
+
+    # -- journal collection --------------------------------------------- #
+    def _collect(self) -> None:
+        offsets: Dict[int, int] = {}
+        for _, rep in self.pool_members():
+            sched = rep.scheduler
+            # the raw list, not the finished_requests copy: this runs
+            # every tick and must only touch the NEW tail
+            fin = sched._finished
+            start = self._fin_offset.get(id(sched), 0)
+            for req in fin[start:]:
+                fr = self._requests.get(req.uid)
+                if fr is None or req.uid in self._collected:
+                    continue
+                self._collected.add(req.uid)
+                fr.state = ("finished" if req.state.value == "finished"
+                            else "failed")
+                fr.finish_reason = req.finish_reason
+                fr.finish_time = time.monotonic()
+                self._n_live -= 1
+                self._finished_order.append(req.uid)
+            offsets[id(sched)] = len(fin)
+        self._fin_offset = offsets
+        if self.keep_finished is not None:
+            while len(self._finished_order) > self.keep_finished:
+                uid = self._finished_order.pop(0)
+                self._requests.pop(uid, None)
+                self._collected.discard(uid)
+
+    # ------------------------------------------------------------------ #
+    # Failure handling: respawn + zero-loss replay
+    # ------------------------------------------------------------------ #
+    def kill_replica(self, name: str,
+                     factory: Optional[SchedulerFactory] = None) -> int:
+        """Chaos entry point: the replica's scheduler AND engine are
+        discarded as a SIGKILL would leave them (nothing is drained,
+        nothing is asked politely), a fresh replica is spawned from the
+        factory (checkpointed engine state), and every in-flight request
+        that was living there is replayed from the fleet journal onto the
+        router's best replica.  Returns the number of requests replayed —
+        zero of them are lost."""
+        self._collect()
+        router, rep = self._find(name)
+        # a snapshot already detached (parked for retry) still names this
+        # replica as its last home — step() owns its replay; replaying it
+        # here too would run the same uid twice
+        parked_uids = {s.uid for s in self._parked}
+        lost = [fr for fr in self._requests.values()
+                if not fr.done and fr.replica == name
+                and fr.uid not in parked_uids]
+        dead = rep.scheduler
+        router.replace_replica(name, (factory or self.factory)(name))
+        # terminalize the dead scheduler's stranded Request objects: they
+        # continue as NEW objects, and anything still holding the old
+        # ones (router tenant-quota views) must see them as gone
+        for req in [*dead._queued, *list(dead._running.values()),
+                    *dead._preempted]:
+            req.finish_reason = "replica_killed"
+            req.transition(RequestState.HANDED_OFF)
+        replayed = 0
+        for fr in lost:
+            self._replay(fr)
+            replayed += 1
+        self.metrics.record_restart(name, replayed)
+        logger.warning(f"fleet: replica {name} killed — respawned, "
+                       f"{replayed} in-flight request(s) replayed")
+        return replayed
+
+    def _replay(self, fr: FleetRequest) -> None:
+        """Continue ``fr`` from the journal on a live replica.  In
+        disaggregated mode the replay re-enters through the prefill pool
+        (its KV died with the replica) and hands off again."""
+        fr.replays += 1
+        self._place(fr.snapshot())
+
+    # ------------------------------------------------------------------ #
+    # Rolling drain-then-restart upgrades
+    # ------------------------------------------------------------------ #
+    def rolling_restart(self, factory: Optional[SchedulerFactory] = None,
+                        drain_deadline_s: float = 5.0,
+                        on_wave: Optional[Callable[[str], None]] = None
+                        ) -> Dict[str, int]:
+        """Upgrade every replica, one wave at a time, with admission open
+        throughout: each wave closes ONE replica's admission
+        (``shutdown(handoff=True)``), lets it drain up to
+        ``drain_deadline_s``, migrates whatever is still unfinished to
+        the rest of the fleet, and swaps in a fresh scheduler from
+        ``factory`` (the new code/weights).  ``on_wave(name)`` runs after
+        each wave — submit traffic from it to prove admission never
+        closed.  Returns ``{replica: requests handed off}``."""
+        handed: Dict[str, int] = {}
+        for pool, rep in list(self.pool_members()):
+            router = self.decode_router if pool == "decode" else self.router
+            _, snaps = rep.scheduler.shutdown(drain_deadline_s,
+                                              handoff=True)
+            # journal whatever FINISHED during the drain BEFORE the old
+            # scheduler (and its _finished list) is discarded
+            self._collect()
+            router.replace_replica(rep.name,
+                                   (factory or self.factory)(rep.name))
+            for snap in snaps:
+                fr = self._requests.get(snap.uid)
+                # recompute handoff: host-side queue insertion only — no
+                # latency sample (the KV-carrying pump times its own);
+                # _place parks on failure, so a full survivor set delays
+                # the migration instead of dropping it
+                self.metrics.record_handoff()
+                if fr is not None:
+                    fr.handoffs += 1
+                self._place(snap)
+            handed[rep.name] = len(snaps)
+            self._collect()
+            if on_wave is not None:
+                on_wave(rep.name)
+        self.metrics.record_rolling_restart()
+        logger.info(f"fleet: rolling restart complete — handoffs per "
+                    f"wave: {handed}")
+        return handed
+
+    # ------------------------------------------------------------------ #
+    # Elastic scale-up/down
+    # ------------------------------------------------------------------ #
+    def _scaled_pool(self) -> Tuple[CacheAwareRouter, str]:
+        """The pool elasticity resizes: the mixed pool, or (disaggregated)
+        the decode pool — decode capacity is what queue depth starves
+        first under FastGen-style traffic."""
+        if self.disaggregated:
+            return self.decode_router, "decode"
+        return self.router, "replica"
+
+    def _autoscale(self) -> None:
+        router, _ = self._scaled_pool()
+        n = len(router.replicas)
+        target = self.autoscaler.observe(self.metrics.snapshot(self), n)
+        if target != n:
+            self.set_replica_count(target)
+
+    def set_replica_count(self, target: int) -> None:
+        """Resize the elastic pool to ``target`` replicas.  Scale-up
+        spawns fresh replicas from the factory; scale-down drains the
+        lightest replicas with handoff — their in-flight requests migrate
+        to the survivors."""
+        router, prefix = self._scaled_pool()
+        n = len(router.replicas)
+        if target < 1:
+            raise ValueError("set_replica_count: target must be >= 1")
+        while len(router.replicas) < target:
+            name = self._next_name(prefix)
+            router.add_replica(name, self.factory(name))
+            self.metrics.record_scale(+1)
+        while len(router.replicas) > max(target, 1):
+            victim = min(router.replicas, key=lambda r: r.load_tokens())
+            _, snaps = victim.scheduler.shutdown(0.0, handoff=True)
+            self._collect()            # finishes already on the victim
+            router.remove_replica(victim.name)
+            for snap in snaps:
+                fr = self._requests.get(snap.uid)
+                if fr is not None:
+                    fr.handoffs += 1
+                self.metrics.record_handoff()
+                # through the front door (in disaggregated mode a drained
+                # decode request must re-prefill on the prefill pool, not
+                # on a sibling decode replica); parks on failure
+                self._place(snap)
+            self.metrics.record_scale(-1)
+        if len(router.replicas) != n:
+            logger.info(f"fleet: elastic resize {n} -> "
+                        f"{len(router.replicas)} replicas")
+
+    # ------------------------------------------------------------------ #
+    # Telemetry
+    # ------------------------------------------------------------------ #
+    def snapshot(self) -> Dict[str, float]:
+        """The merged ``fleet/*`` telemetry namespace."""
+        return self.metrics.snapshot(self)
+
+    def export_metrics(self, monitor=None):
+        return self.metrics.export(self, monitor=monitor)

@@ -1,0 +1,132 @@
+"""Fleet-level telemetry: one ``fleet/*`` namespace merging the router's
+placement counters, every replica's ``ServingMetrics``, and the fleet's
+own lifecycle events (restarts, replays, handoffs, scale moves).
+
+Two consumers, one source of truth:
+
+* the **elasticity policy** (:class:`~deepspeed_tpu.fleet.elastic.
+  FleetAutoscaler`) reads :meth:`snapshot` — per-pool queue depth and
+  rolling goodput are its scale signals;
+* the **monitor writers** (TensorBoard / WandB / CSV) receive the same
+  scalars through :meth:`export`, wall-clock-x'd exactly like the
+  ``serving/*`` series (see :class:`ServingMetrics.export`).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class FleetMetrics:
+    """Aggregates a :class:`~deepspeed_tpu.fleet.fleet.ServingFleet`'s
+    telemetry.  The fleet calls the ``record_*`` hooks; :meth:`snapshot`
+    folds in the live router/replica state at read time."""
+
+    def __init__(self, monitor=None):
+        self.monitor = monitor
+        self.started = time.monotonic()
+        self.restarts = 0            # replicas respawned after crash/hang
+        self.replays = 0             # in-flight requests re-routed alive
+        self.handoffs = 0            # prefill→decode + drain migrations
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.rolling_restarts = 0    # completed upgrade waves
+        #: bounded: a long-running fleet must not grow host memory per
+        #: handoff — percentiles are over the most recent window
+        self.handoff_latency_s: Deque[float] = deque(maxlen=1024)
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle hooks (called by the fleet)
+    # ------------------------------------------------------------------ #
+    def record_restart(self, replica: str, replayed: int) -> None:
+        self.restarts += 1
+        self.replays += replayed
+
+    def record_handoff(self, latency_s: Optional[float] = None) -> None:
+        self.handoffs += 1
+        if latency_s is not None:
+            self.handoff_latency_s.append(latency_s)
+
+    def record_scale(self, direction: int) -> None:
+        if direction > 0:
+            self.scale_ups += 1
+        elif direction < 0:
+            self.scale_downs += 1
+
+    def record_rolling_restart(self) -> None:
+        self.rolling_restarts += 1
+
+    # ------------------------------------------------------------------ #
+    # Aggregation
+    # ------------------------------------------------------------------ #
+    def snapshot(self, fleet=None) -> Dict[str, float]:
+        """``fleet/*`` scalars.  With ``fleet`` given, live state (replica
+        counts, per-pool queue depth, rolling goodput, router counters,
+        summed replica ServingMetrics) is folded in; without it only the
+        fleet-lifetime counters appear."""
+        out: Dict[str, float] = {
+            "fleet/restarts": float(self.restarts),
+            "fleet/replayed_requests": float(self.replays),
+            "fleet/handoffs": float(self.handoffs),
+            "fleet/scale_ups": float(self.scale_ups),
+            "fleet/scale_downs": float(self.scale_downs),
+            "fleet/rolling_restarts": float(self.rolling_restarts),
+        }
+        if self.handoff_latency_s:
+            lat = np.asarray(list(self.handoff_latency_s), np.float64)
+            out["fleet/p50_handoff_s"] = float(np.percentile(lat, 50))
+            out["fleet/p95_handoff_s"] = float(np.percentile(lat, 95))
+        if fleet is None:
+            return out
+        # client-level request accounting (a handed-off request counts
+        # once here, however many schedulers it visited)
+        frs = fleet.requests
+        out["fleet/requests"] = float(len(frs))
+        out["fleet/requests_live"] = float(
+            sum(1 for fr in frs if not fr.done))
+        out["fleet/requests_finished"] = float(
+            sum(1 for fr in frs if fr.state == "finished"))
+        out["fleet/requests_failed"] = float(
+            sum(1 for fr in frs if fr.state == "failed"))
+        pools: Dict[str, List] = {}
+        for name, rep in fleet.pool_members():
+            pools.setdefault(name, []).append(rep)
+        out["fleet/replicas"] = float(
+            sum(len(v) for v in pools.values()))
+        goodput = 0.0
+        agg = {"submitted": 0.0, "finished": 0.0, "failed": 0.0,
+               "preemptions": 0.0, "total_tokens": 0.0}
+        for pool, reps in pools.items():
+            out[f"fleet/replicas_{pool}"] = float(len(reps))
+            out[f"fleet/queue_depth_{pool}"] = float(
+                sum(r.scheduler.backlog_tokens() for r in reps))
+            out[f"fleet/pending_{pool}"] = float(
+                sum(r.scheduler.num_pending for r in reps))
+            for r in reps:
+                m = r.scheduler.metrics
+                goodput += m.goodput_tokens_per_s()
+                for k in agg:
+                    agg[k] += float(getattr(m, k))
+        out["fleet/goodput_tokens_per_s"] = goodput
+        for k, v in agg.items():
+            out[f"fleet/{k}"] = v
+        for k, v in fleet.router.snapshot().items():
+            out[f"fleet/router_{k}"] = float(v)
+        return out
+
+    # ------------------------------------------------------------------ #
+    # Monitor fan-out (same wall-clock-x contract as ServingMetrics)
+    # ------------------------------------------------------------------ #
+    def export(self, fleet=None, monitor=None,
+               now: Optional[float] = None
+               ) -> List[Tuple[str, float, float]]:
+        monitor = monitor if monitor is not None else self.monitor
+        wall = time.time() if now is None else now
+        events = [(k, v, wall) for k, v in self.snapshot(fleet).items()]
+        if monitor is not None and getattr(monitor, "enabled", False):
+            monitor.write_events(events)
+        return events

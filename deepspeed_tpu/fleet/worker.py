@@ -1,0 +1,375 @@
+"""Process-separated serving replicas under real supervision.
+
+:class:`~deepspeed_tpu.fleet.fleet.ServingFleet` composes replicas
+in-process (one engine per replica, one python process) — the right shape
+for tests, benches, and single-host serving.  This module is the same
+fleet contract across PROCESS boundaries, so a replica can actually be
+SIGKILLed, OOM-killed, or wedged and the system provably recovers:
+
+* each replica is a **worker subprocess** (:func:`run_replica_worker`)
+  driving its own ``ContinuousBatchScheduler``; it consumes request
+  snapshots from a spool-directory inbox and appends every emitted token
+  to an ``events.jsonl`` journal (crash-durable: what was flushed is
+  recovered, what wasn't is deterministically regenerated on replay);
+* each worker runs under its own
+  :class:`~deepspeed_tpu.resilience.supervisor.JobSupervisor` — ONE
+  supervisor per replica, so a crash or hang restarts that replica alone
+  (the whole-group teardown a training job wants is exactly wrong for a
+  serving fleet).  The scheduler ticks the supervisor's heartbeat file
+  every step (``Heartbeat.from_env``), so a wedged engine forward reads
+  as a hang, gets a SIGUSR1 stack dump, and is killed and respawned;
+* the :class:`FleetFrontEnd` (parent process) journals every request —
+  prompt, sampling seed, every token read back — routes by load, watches
+  the supervisors, and on a replica's death/restart replays that
+  replica's in-flight requests from the journal: the replay snapshot
+  carries the delivered tokens as its ``generated`` prefix, so the
+  ``(seed, uid, position)``-keyed sampler continues the exact stream.
+  A killed replica loses ZERO requests.
+
+The IPC is deliberately files-only (atomic-rename inbox, append-only
+event journal, mtime heartbeats) — the same crash-survivable primitives
+the checkpoint and heartbeat layers already trust, with no sockets to
+leak or deadlock.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.fleet.fleet import FleetRequest
+from deepspeed_tpu.resilience import heartbeat as hb
+from deepspeed_tpu.resilience.supervisor import (BackoffPolicy,
+                                                 JobSupervisor, WorkerSpec)
+from deepspeed_tpu.serving.request import RequestSnapshot, SamplingParams
+from deepspeed_tpu.utils.logging import logger
+
+STOP_FILE = "stop"
+INBOX_DIR = "inbox"
+#: exported by FleetFrontEnd per launch: each worker incarnation appends
+#: to its OWN event journal (``events.<attempt>.jsonl``), so a SIGKILL's
+#: torn tail line can never interleave with the respawn's first events
+ENV_INCARNATION = "DS_FLEET_INCARNATION"
+
+
+def events_path(spool_dir: str, attempt: int) -> str:
+    return os.path.join(spool_dir, f"events.{attempt}.jsonl")
+
+
+# --------------------------------------------------------------------- #
+# Worker side
+# --------------------------------------------------------------------- #
+def run_replica_worker(spool_dir: str, scheduler,
+                       poll_s: float = 0.005,
+                       drain_deadline_s: float = 30.0) -> int:
+    """Serve one replica until the front-end drops a ``stop`` file.
+
+    Per loop iteration: consume inbox snapshots (read + unlink, then
+    submit — a request deleted but not yet submitted when a kill lands is
+    still safe: the FRONT-END journal is the source of truth and replays
+    it), run one scheduler tick when work is pending (the tick beats the
+    supervisor heartbeat), and append ``{"uid", "tok"}`` /
+    ``{"uid", "done", "state"}`` lines to the event journal."""
+    inbox = os.path.join(spool_dir, INBOX_DIR)
+    os.makedirs(inbox, exist_ok=True)
+    stop_path = os.path.join(spool_dir, STOP_FILE)
+    seen_finished = 0
+    attempt = int(os.environ.get(ENV_INCARNATION, "0"))
+    with open(events_path(spool_dir, attempt), "a") as ev:
+
+        def flush_finished() -> None:
+            nonlocal seen_finished
+            fin = scheduler.finished_requests
+            for req in fin[seen_finished:]:
+                ev.write(json.dumps({
+                    "uid": req.uid, "done": req.finish_reason,
+                    "state": req.state.value,
+                    "n": len(req.generated)}) + "\n")
+            seen_finished = len(fin)
+            ev.flush()
+
+        while True:
+            for name in sorted(os.listdir(inbox)):
+                path = os.path.join(inbox, name)
+                try:
+                    with open(path) as f:
+                        snap = RequestSnapshot.from_json(f.read())
+                    os.remove(path)
+                except (OSError, ValueError):
+                    continue      # torn write: the front-end will rewrite
+                try:
+                    scheduler.resubmit(snap)
+                except (ValueError, RuntimeError) as e:
+                    # ValueError (bad snapshot / live uid) AND RuntimeError
+                    # (QueueFullError burst, draining scheduler): a
+                    # rejected request must become a journal event the
+                    # front-end can see, never a worker crash loop
+                    logger.warning(f"replica worker: rejected snapshot "
+                                   f"{snap.uid}: {e}")
+                    ev.write(json.dumps({"uid": snap.uid,
+                                         "done": "rejected",
+                                         "state": "failed", "n": 0}) + "\n")
+            if os.path.exists(stop_path):
+                scheduler.shutdown(drain_deadline_s)
+                flush_finished()
+                os.fsync(ev.fileno())
+                return 0
+            if scheduler.num_pending:
+                for req, tok in scheduler.step():
+                    ev.write(json.dumps({"uid": req.uid,
+                                         "tok": int(tok)}) + "\n")
+            else:
+                hb.tick_active()        # idle replicas are not hung
+                time.sleep(poll_s)
+            flush_finished()
+
+
+# --------------------------------------------------------------------- #
+# Front-end side
+# --------------------------------------------------------------------- #
+class FleetFrontEnd:
+    """Supervised multi-process fleet front door (see module doc).
+
+    ``worker_argv_fn(name, spool_dir) -> List[str]`` builds the worker
+    subprocess command — it must end up calling
+    :func:`run_replica_worker` over a scheduler rebuilt from checkpointed
+    engine state (so respawn never depends on anything the dead process
+    knew)."""
+
+    def __init__(self, worker_argv_fn: Callable[[str, str], List[str]],
+                 n_replicas: int, run_dir: str, *,
+                 heartbeat_interval_s: float = 1.0,
+                 hang_timeout_s: Optional[float] = None,
+                 startup_timeout_s: float = 120.0,
+                 max_restarts: int = 3,
+                 restart_window_s: float = 300.0,
+                 backoff: Optional[BackoffPolicy] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 keep_finished: Optional[int] = None):
+        if n_replicas < 1:
+            raise ValueError("FleetFrontEnd needs at least one replica")
+        self.run_dir = run_dir
+        os.makedirs(run_dir, exist_ok=True)
+        self._uid_counter = itertools.count(1)
+        self._rr = itertools.count()
+        self.requests: Dict[int, FleetRequest] = {}
+        #: O(1) load/pending reads — submit/poll must not scan the
+        #: lifetime journal (same fix ServingFleet carries)
+        self._outstanding_by: Dict[str, int] = {}
+        self._n_live = 0
+        #: None keeps every FleetRequest; an int bounds journal memory on
+        #: long-running front-ends by pruning the oldest finished entries
+        self.keep_finished = keep_finished
+        self._finished_order: List[int] = []
+        self.replays = 0
+        self.restarts_seen: Dict[str, int] = {}
+        #: byte offsets into event journals, keyed (replica, incarnation)
+        self._offsets: Dict[tuple, int] = {}
+        self.spools: Dict[str, str] = {}
+        self.supervisors: Dict[str, JobSupervisor] = {}
+        for i in range(n_replicas):
+            name = f"replica{i}"
+            spool = os.path.join(run_dir, name)
+            os.makedirs(os.path.join(spool, INBOX_DIR), exist_ok=True)
+            self.spools[name] = spool
+            argv = worker_argv_fn(name, spool)
+
+            def spec_fn(hosts, attempt, _argv=argv, _name=name,
+                        _env=dict(env or {})):
+                env_ = dict(_env)
+                env_[ENV_INCARNATION] = str(attempt)
+                return [WorkerSpec(host=_name, cmd=list(_argv), env=env_)]
+
+            self.supervisors[name] = JobSupervisor(
+                spec_fn, [name],
+                run_dir=os.path.join(spool, "supervisor"),
+                heartbeat_interval_s=heartbeat_interval_s,
+                hang_timeout_s=hang_timeout_s,
+                startup_timeout_s=startup_timeout_s,
+                max_restarts=max_restarts,
+                restart_window_s=restart_window_s,
+                backoff=backoff or BackoffPolicy(base_s=0.2, jitter=0.1),
+                blacklist_after=max_restarts + 1,  # one host: never shrink
+                min_hosts=1)
+            self.restarts_seen[name] = 0
+        for sup in self.supervisors.values():
+            sup.start()
+
+    # -- submission ----------------------------------------------------- #
+    def _outstanding(self, name: str) -> int:
+        return self._outstanding_by.get(name, 0)
+
+    def _move(self, fr: FleetRequest, target: Optional[str]) -> None:
+        """Re-home ``fr``'s outstanding count (``target=None`` = done)."""
+        if fr.replica is not None:
+            self._outstanding_by[fr.replica] = max(
+                self._outstanding_by.get(fr.replica, 0) - 1, 0)
+        if target is not None:
+            self._outstanding_by[target] = \
+                self._outstanding_by.get(target, 0) + 1
+
+    def _pick_replica(self) -> str:
+        names = list(self.spools)
+        rr = next(self._rr)
+        return min(names, key=lambda n: (
+            self._outstanding(n), (names.index(n) - rr) % len(names)))
+
+    def _write_snapshot(self, name: str, snap: RequestSnapshot) -> None:
+        inbox = os.path.join(self.spools[name], INBOX_DIR)
+        tmp = os.path.join(inbox, f".{snap.uid}.tmp")
+        with open(tmp, "w") as f:
+            f.write(snap.to_json())
+        os.replace(tmp, os.path.join(inbox, f"{snap.uid}.json"))
+
+    def submit(self, prompt, sampling: Optional[SamplingParams] = None,
+               tenant: str = "default") -> FleetRequest:
+        uid = next(self._uid_counter)
+        fr = FleetRequest(uid=uid, prompt=[int(t) for t in prompt],
+                          sampling=sampling or SamplingParams(),
+                          tenant=tenant)
+        name = self._pick_replica()
+        self._move(fr, name)
+        fr.replicas.append(name)
+        self.requests[uid] = fr
+        self._n_live += 1
+        self._write_snapshot(name, fr.snapshot())
+        return fr
+
+    # -- event ingestion ------------------------------------------------ #
+    def _drain_events(self, name: str, attempt: Optional[int] = None,
+                      final: bool = False) -> None:
+        """Consume new journal lines from one incarnation's event file.
+        Live files are read only up to the last complete line (a write
+        may be mid-flush); ``final=True`` (the incarnation is dead) also
+        consumes the tail — a torn tail line is skipped for good, and
+        replay deterministically regenerates whatever it carried."""
+        if attempt is None:
+            attempt = self.restarts_seen[name]
+        path = events_path(self.spools[name], attempt)
+        key = (name, attempt)
+        try:
+            with open(path, "rb") as f:
+                f.seek(self._offsets.get(key, 0))
+                chunk = f.read()
+        except OSError:
+            return
+        if not final:
+            end = chunk.rfind(b"\n")
+            if end < 0:
+                return
+            chunk = chunk[:end + 1]
+        self._offsets[key] = self._offsets.get(key, 0) + len(chunk)
+        for line in chunk.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue             # torn tail of a dead incarnation
+            fr = self.requests.get(rec.get("uid"))
+            if fr is None or fr.done:
+                continue
+            if fr.replica != name:
+                # a stale copy (e.g. an unconsumed inbox file executed by
+                # a respawned worker after the request was replayed
+                # elsewhere) — its stream is not the one we're tracking
+                continue
+            if "tok" in rec:
+                fr.tokens.append(int(rec["tok"]))
+                if fr.first_token_time is None:
+                    fr.first_token_time = time.monotonic()
+                if fr.on_token is not None:
+                    fr.on_token(fr, int(rec["tok"]))
+            elif "done" in rec:
+                if rec["done"] == "rejected" and fr.replays < 5:
+                    # admission rejection (queue burst, draining worker):
+                    # bounce to another replica instead of failing — a
+                    # bounded number of times, so a truly unservable
+                    # request still terminates
+                    fr.replays += 1
+                    self.replays += 1
+                    target = self._pick_replica()
+                    self._move(fr, target)
+                    fr.replicas.append(target)
+                    self._write_snapshot(target, fr.snapshot())
+                    continue
+                fr.state = ("finished" if rec.get("state") == "finished"
+                            else "failed")
+                fr.finish_reason = rec["done"]
+                fr.finish_time = time.monotonic()
+                self._move(fr, None)
+                self._n_live -= 1
+                self._finished_order.append(fr.uid)
+                if self.keep_finished is not None:
+                    while len(self._finished_order) > self.keep_finished:
+                        self.requests.pop(self._finished_order.pop(0),
+                                          None)
+
+    # -- supervision + replay ------------------------------------------- #
+    def _check_restarts(self) -> None:
+        for name, sup in self.supervisors.items():
+            if sup.returncode is not None and sup.returncode != 0:
+                raise RuntimeError(
+                    f"fleet front-end: replica {name} is unrecoverable "
+                    f"({sup.error})")
+            if sup.attempt > self.restarts_seen[name]:
+                # the dead incarnations' journals are final: recover every
+                # flushed token BEFORE building replay snapshots
+                for old in range(self.restarts_seen[name], sup.attempt):
+                    self._drain_events(name, attempt=old, final=True)
+                self.restarts_seen[name] = sup.attempt
+                # unconsumed inbox files would make the respawned worker
+                # re-run requests we are about to replay elsewhere
+                inbox = os.path.join(self.spools[name], INBOX_DIR)
+                for stale in os.listdir(inbox):
+                    try:
+                        os.remove(os.path.join(inbox, stale))
+                    except OSError:
+                        pass
+                lost = [fr for fr in self.requests.values()
+                        if not fr.done and fr.replica == name]
+                for fr in lost:
+                    fr.replays += 1
+                    self.replays += 1
+                    target = self._pick_replica()
+                    self._move(fr, target)
+                    fr.replicas.append(target)
+                    self._write_snapshot(target, fr.snapshot())
+                logger.warning(
+                    f"fleet front-end: replica {name} restarted "
+                    f"(attempt {sup.attempt}) — replayed {len(lost)} "
+                    f"in-flight request(s)")
+
+    # -- driving -------------------------------------------------------- #
+    @property
+    def num_pending(self) -> int:
+        return self._n_live
+
+    def poll(self) -> None:
+        for name in self.spools:
+            self._drain_events(name)
+        self._check_restarts()
+
+    def run_until_idle(self, timeout_s: float = 120.0,
+                       poll_s: float = 0.02) -> List[FleetRequest]:
+        deadline = time.monotonic() + timeout_s
+        while self.num_pending and time.monotonic() < deadline:
+            self.poll()
+            if self.num_pending:
+                time.sleep(poll_s)
+        self.poll()
+        return list(self.requests.values())
+
+    def stop(self, timeout_s: float = 60.0) -> None:
+        """Drop stop files (workers drain and exit 0), join the
+        supervisors, escalate through ``JobSupervisor.stop`` for
+        stragglers."""
+        for spool in self.spools.values():
+            with open(os.path.join(spool, STOP_FILE), "w") as f:
+                f.write("stop")
+        deadline = time.monotonic() + timeout_s
+        for name, sup in self.supervisors.items():
+            sup.wait(timeout=max(deadline - time.monotonic(), 0.1))
+        for sup in self.supervisors.values():
+            sup.stop()

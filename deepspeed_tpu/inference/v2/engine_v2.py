@@ -608,32 +608,88 @@ class InferenceEngineV2:
     # recomputed KV is bit-identical in effect and generation continues
     # token-for-token as if never preempted.
     # ------------------------------------------------------------------ #
-    def flush_to_host(self, uids: Sequence[int]) -> Dict[int, Dict[str, int]]:
+    def flush_to_host(self, uids: Sequence[int],
+                      include_kv: bool = False) -> Dict[int, Dict[str, Any]]:
         """Release device KV for ``uids`` (preemption).  Returns per-uid
         host snapshots ``{"seen_tokens", "pending_tokens"}`` — the caller
-        owns the token history and re-admits via :meth:`resume`."""
-        out: Dict[int, Dict[str, int]] = {}
+        owns the token history and re-admits via :meth:`resume`.
+
+        ``include_kv=True`` additionally gathers each sequence's actual
+        KV rows to the host (``"kv"``: a per-layer ``{"k"/"v"}`` tree of
+        ``[blocks * block_size, Hkv, D]`` arrays in block-table order) so
+        another engine over the same model can :meth:`resume` WITHOUT the
+        recompute re-prefill — the disaggregated prefill→decode handoff."""
+        out: Dict[int, Dict[str, Any]] = {}
         for uid in uids:
             seq = self.state_manager.get_sequence(uid)
             if seq is None:
                 raise ValueError(f"flush_to_host: unknown sequence {uid}")
-            out[uid] = {"seen_tokens": seq.seen_tokens,
-                        "pending_tokens": len(seq.pending)}
+            snap: Dict[str, Any] = {"seen_tokens": seq.seen_tokens,
+                                    "pending_tokens": len(seq.pending)}
+            if include_kv and seq.blocks:
+                snap["kv"] = self.state_manager.kv_cache.gather_blocks(
+                    seq.blocks)
+                snap["block_size"] = self.state_manager.block_size
+            out[uid] = snap
         self.flush(uids)
         return out
 
-    def resume(self, uid: int, tokens: Sequence[int],
-               sync: bool = True) -> Dict[int, np.ndarray]:
-        """Re-admit a flushed sequence by recompute: re-prefill its full
-        token history (prompt + tokens generated before preemption) and
-        return the last token's logits, exactly as :meth:`put` would.
-        The sequence must not be live (it was flushed by
-        :meth:`flush_to_host`)."""
-        if self.state_manager.get_sequence(uid) is not None:
+    def resume(self, uid: int, tokens: Sequence[int], sync: bool = True,
+               kv_state: Optional[Dict[str, Any]] = None
+               ) -> Dict[int, np.ndarray]:
+        """Re-admit a flushed sequence.  The sequence must not be live
+        (it was flushed by :meth:`flush_to_host`).
+
+        Without ``kv_state``: recompute — re-prefill the full token
+        history (prompt + tokens generated before preemption) and return
+        the last token's logits, exactly as :meth:`put` would.
+
+        With ``kv_state`` (a :meth:`flush_to_host(include_kv=True)`
+        snapshot, possibly from ANOTHER engine of identical geometry):
+        allocate fresh blocks, scatter the carried KV rows in, and mark
+        ``tokens[:seen_tokens]`` as already seen — no recompute.  Only
+        the tail ``tokens[seen_tokens:]`` (if any) runs through
+        :meth:`put`; when the tail is empty the return is ``{}`` and the
+        next :meth:`decode_step`/``put`` feeds from position
+        ``seen_tokens``."""
+        sm = self.state_manager
+        if sm.get_sequence(uid) is not None:
             raise RuntimeError(
                 f"resume: sequence {uid} is still live — it was never "
                 f"flushed, or the uid was reused")
-        return self.put([uid], [tokens], sync=sync)
+        if kv_state is None or "kv" not in kv_state:
+            return self.put([uid], [tokens], sync=sync)
+        seen = int(kv_state["seen_tokens"])
+        if not 0 < seen <= len(tokens):
+            raise ValueError(
+                f"resume: kv_state covers {seen} tokens but {len(tokens)} "
+                f"token values were supplied")
+        if kv_state.get("block_size", sm.block_size) != sm.block_size:
+            raise ValueError(
+                f"resume: kv_state block_size "
+                f"{kv_state.get('block_size')} != {sm.block_size}")
+        n_blocks = -(-seen // sm.block_size)
+        seq = sm.get_or_create_sequence(uid)
+        try:
+            seq.blocks = sm._allocate(n_blocks)
+            payload = kv_state["kv"]
+            need_rows = n_blocks * sm.block_size
+            payload = jax.tree_util.tree_map(
+                lambda h: np.asarray(h)[:need_rows], payload)
+            sm.kv_cache.scatter_blocks(seq.blocks, payload)
+        except Exception:
+            if seq.blocks:
+                sm.allocator.free(seq.blocks)
+            del sm._seqs[uid]
+            raise
+        seq.seen_tokens = seen
+        sm.record_fed_tokens(seq, tokens[:seen])
+        sm.register_prefix(seq)
+        # freshly scattered blocks invalidate any cached decode tables
+        self._dev_decode_state = None
+        if len(tokens) > seen:
+            return self.put([uid], [list(tokens)[seen:]], sync=sync)
+        return {}
 
     # ------------------------------------------------------------------ #
     # serialize (reference engine_v2.py:237 + flat_model_helpers.py —

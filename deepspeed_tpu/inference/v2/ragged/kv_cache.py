@@ -48,6 +48,43 @@ class BlockedKVCache:
         self.cache = _copy_block(self.cache, jnp.int32(src), jnp.int32(dst),
                                  self.block_size)
 
+    def _block_rows(self, blocks) -> "jax.Array":
+        """Flat pool row indices covering ``blocks`` in table order."""
+        import numpy as np
+
+        base = np.asarray(blocks, np.int32)[:, None] * self.block_size
+        return jnp.asarray(
+            (base + np.arange(self.block_size, dtype=np.int32)).ravel())
+
+    def gather_blocks(self, blocks) -> Dict[str, Dict[str, Any]]:
+        """Pull the KV rows of ``blocks`` (one sequence's block table) to
+        the host: ``{layer: {"k"/"v": np[len(blocks)*block_size, H, D]}}``.
+        One device gather + one transfer for the whole tree — the
+        disaggregated prefill→decode handoff payload.  Row order follows
+        the block table, so position ``p`` lives at row ``p`` regardless
+        of which physical blocks held it."""
+        rows = self._block_rows(blocks)
+        return jax.device_get(
+            jax.tree_util.tree_map(lambda a: a[rows], self.cache))
+
+    def scatter_blocks(self, blocks, host_tree) -> None:
+        """Write a :meth:`gather_blocks` payload into ``blocks`` of THIS
+        pool (functional update, stored back like the forward's).  Shapes
+        must match this cache's geometry — a handoff between replicas of
+        different model geometry is a deployment error, not a cast."""
+        rows = self._block_rows(blocks)
+        n = int(rows.shape[0])
+
+        def one(a, h):
+            h = jnp.asarray(h, a.dtype)
+            if h.shape != (n,) + a.shape[1:]:
+                raise ValueError(
+                    f"scatter_blocks: payload {h.shape} does not match "
+                    f"{(n,) + a.shape[1:]} (cache geometry differs)")
+            return a.at[rows].set(h)
+
+        self.cache = jax.tree_util.tree_map(one, self.cache, host_tree)
+
     @property
     def per_token_bytes(self) -> int:
         itemsize = jnp.dtype(self.dtype).itemsize

@@ -15,8 +15,8 @@ Typical use::
 """
 
 from deepspeed_tpu.serving.metrics import ServingMetrics
-from deepspeed_tpu.serving.request import (Request, RequestState,
-                                           SamplingParams)
+from deepspeed_tpu.serving.request import (Request, RequestSnapshot,
+                                           RequestState, SamplingParams)
 from deepspeed_tpu.serving.router import (AdmissionRejectedError,
                                           CacheAwareRouter, PriorityClass,
                                           QuotaExceededError, Replica,
@@ -27,6 +27,6 @@ from deepspeed_tpu.serving.scheduler import (ContinuousBatchScheduler,
 
 __all__ = ["AdmissionRejectedError", "CacheAwareRouter",
            "ContinuousBatchScheduler", "PriorityClass", "QueueFullError",
-           "QuotaExceededError", "Replica", "Request", "RequestState",
-           "SamplingParams", "ServingMetrics", "TenantQuota",
-           "sample_batch", "sample_one"]
+           "QuotaExceededError", "Replica", "Request", "RequestSnapshot",
+           "RequestState", "SamplingParams", "ServingMetrics",
+           "TenantQuota", "sample_batch", "sample_one"]

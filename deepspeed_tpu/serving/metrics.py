@@ -47,6 +47,7 @@ class ServingMetrics:
         self.deadline_exceeded = 0       # failed with reason "deadline"
         self.shutdown_failed = 0         # failed with reason "shutdown"
         self.preemptions = 0
+        self.handoffs = 0                # requests handed to another replica
         self.preempted_requests = 0      # ever preempted (incl. in-flight)
         self._terminal_preempted = 0     # preempted AND reached a terminal state
         self.total_tokens = 0            # tokens of FINISHED requests only
@@ -69,6 +70,11 @@ class ServingMetrics:
         self.preemptions += 1
         if req.preemptions == 1:
             self.preempted_requests += 1
+
+    def record_handoff(self, req: Request) -> None:
+        """The request left this scheduler ALIVE (drain-handoff or
+        prefill→decode migration) — neither finished nor failed here."""
+        self.handoffs += 1
 
     def record_finish(self, req: Request) -> None:
         now = time.monotonic()
@@ -130,6 +136,7 @@ class ServingMetrics:
             "deadline_exceeded": float(self.deadline_exceeded),
             "shutdown_failed": float(self.shutdown_failed),
             "preemptions": float(self.preemptions),
+            "handoffs": float(self.handoffs),
             "preempted_requests": float(self.preempted_requests),
             "preemption_rate": self.preemption_rate(),
             "total_tokens": float(self.total_tokens),

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import json
 import time
 from typing import Callable, List, Optional, Tuple
 
@@ -33,19 +34,24 @@ class RequestState(enum.Enum):
     PREEMPTED = "preempted"  # KV flushed under pressure; awaiting re-admission
     FINISHED = "finished"    # terminal: stop token / length reached
     FAILED = "failed"        # terminal: could never be scheduled
+    HANDED_OFF = "handed_off"  # terminal HERE: continues on another replica
 
 
 #: Legal state-machine edges (from -> to). Anything else is a scheduler bug.
 _TRANSITIONS = {
-    RequestState.QUEUED: {RequestState.PREFILL, RequestState.FAILED},
+    RequestState.QUEUED: {RequestState.PREFILL, RequestState.FAILED,
+                          RequestState.HANDED_OFF},
     RequestState.PREFILL: {RequestState.DECODE, RequestState.PREEMPTED,
-                           RequestState.FINISHED, RequestState.FAILED},
+                           RequestState.FINISHED, RequestState.FAILED,
+                           RequestState.HANDED_OFF},
     RequestState.DECODE: {RequestState.DECODE, RequestState.PREEMPTED,
-                          RequestState.FINISHED, RequestState.FAILED},
+                          RequestState.FINISHED, RequestState.FAILED,
+                          RequestState.HANDED_OFF},
     RequestState.PREEMPTED: {RequestState.PREFILL, RequestState.FINISHED,
-                             RequestState.FAILED},
+                             RequestState.FAILED, RequestState.HANDED_OFF},
     RequestState.FINISHED: set(),
     RequestState.FAILED: set(),
+    RequestState.HANDED_OFF: set(),
 }
 
 
@@ -154,7 +160,10 @@ class Request:
 
     @property
     def done(self) -> bool:
-        return self.state in (RequestState.FINISHED, RequestState.FAILED)
+        """Terminal on THIS replica (a HANDED_OFF request lives on as a
+        new object elsewhere — see :class:`RequestSnapshot`)."""
+        return self.state in (RequestState.FINISHED, RequestState.FAILED,
+                              RequestState.HANDED_OFF)
 
     def transition(self, new_state: RequestState) -> None:
         if new_state not in _TRANSITIONS[self.state]:
@@ -189,6 +198,29 @@ class Request:
             return "length"
         return None
 
+    # -- handoff ------------------------------------------------------- #
+    def snapshot(self, fed_tokens: int = 0) -> "RequestSnapshot":
+        """Serializable replay state for cross-replica handoff (see
+        :class:`RequestSnapshot`).  ``fed_tokens`` > 0 records how many
+        history tokens have device KV travelling WITH the snapshot (the
+        disaggregated prefill→decode path); 0 means recompute-replay."""
+        remaining = None
+        if self.deadline_s is not None:
+            remaining = max(
+                self.deadline_s - (time.monotonic() - self.arrival_time),
+                1e-3)
+        return RequestSnapshot(
+            uid=self.uid,
+            prompt=list(self.prompt),
+            generated=list(self.generated),
+            sampling=dataclasses.asdict(self.sampling),
+            priority=self.priority,
+            deadline_s=remaining,
+            tenant=self.tenant,
+            preemptions=self.preemptions,
+            fed_tokens=fed_tokens,
+        )
+
     # -- derived SLO metrics ------------------------------------------- #
     @property
     def ttft(self) -> Optional[float]:
@@ -211,3 +243,64 @@ class Request:
             return None
         span = self.last_token_time - self.first_token_time
         return span / (len(self.generated) - 1)
+
+
+@dataclasses.dataclass
+class RequestSnapshot:
+    """Everything needed to continue a request on ANOTHER replica:
+    the prompt, every token already emitted, the full sampling config
+    (seed included), and the admission attributes (tenant / priority /
+    remaining deadline).
+
+    Replay contract: :meth:`to_request` rebuilds a QUEUED request whose
+    ``generated`` is pre-seeded with the emitted tokens — the target
+    scheduler re-prefills ``prompt + generated`` (or attaches the span
+    carried as KV, see ``fed_tokens``) and generation continues at
+    position ``len(generated)``.  Because sampling noise is keyed by
+    ``(seed, uid, position)`` and the uid is preserved, the continuation
+    is the exact token stream the request would have produced uninterrupted
+    (greedy: always; stochastic: same draws, same tokens up to logits
+    rounding across kernels).
+    """
+
+    uid: int
+    prompt: List[int]
+    generated: List[int]
+    #: ``dataclasses.asdict(SamplingParams)`` — JSON-clean
+    sampling: dict
+    priority: int = 0
+    #: deadline REMAINING at snapshot time (the clock restarts at
+    #: resubmission; the client's budget keeps draining across the hop)
+    deadline_s: Optional[float] = None
+    tenant: Optional[str] = None
+    preemptions: int = 0
+    #: leading ``history`` tokens whose KV travels with the snapshot
+    #: (``flush_to_host(include_kv=True)`` payload); 0 = recompute-replay
+    fed_tokens: int = 0
+
+    @property
+    def history(self) -> List[int]:
+        return self.prompt + self.generated
+
+    def to_request(self, on_token=None) -> Request:
+        """Reconstruct a QUEUED :class:`Request` ready for
+        ``scheduler.submit(request=...)`` / ``scheduler.resubmit``.  The
+        uid is preserved — it keys the sampling noise stream."""
+        sampling = dict(self.sampling)
+        sampling["stop_token_ids"] = tuple(
+            sampling.get("stop_token_ids", ()))
+        req = Request(uid=self.uid, prompt=list(self.prompt),
+                      sampling=SamplingParams(**sampling),
+                      priority=self.priority, deadline_s=self.deadline_s,
+                      on_token=on_token)
+        req.generated = list(self.generated)
+        req.preemptions = self.preemptions
+        req.tenant = self.tenant
+        return req
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "RequestSnapshot":
+        return cls(**json.loads(text))

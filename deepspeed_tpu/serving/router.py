@@ -101,6 +101,12 @@ class Replica:
         return self.scheduler.backlog_tokens()
 
     @property
+    def accepting(self) -> bool:
+        """False while the replica drains for a rolling restart — the
+        router must place traffic elsewhere."""
+        return getattr(self.scheduler, "accepting_submissions", True)
+
+    @property
     def num_pending(self) -> int:
         return self.scheduler.num_pending
 
@@ -170,10 +176,15 @@ class CacheAwareRouter:
 
     def _ranked(self, prompt: Sequence[int]) -> List[Tuple[float, int, int,
                                                            Replica]]:
-        """All replicas in placement-preference order: highest
+        """Accepting replicas in placement-preference order: highest
         cache-minus-load score, ties to the lighter replica, then
-        rotating round-robin so equal replicas share cold traffic."""
-        scored = self._score(prompt)
+        rotating round-robin so equal replicas share cold traffic.
+        Draining replicas (rolling restart) are never candidates."""
+        scored = [s for s in self._score(prompt) if s[3].accepting]
+        if not scored:
+            raise RuntimeError(
+                "router: no replica is accepting submissions (the whole "
+                "fleet is draining) — retry after the upgrade wave")
         rr = next(self._rr)
         n = len(scored)
         order = sorted(
@@ -322,6 +333,74 @@ class CacheAwareRouter:
         logger.debug(f"router: request {req.uid} (tenant={tenant}) -> "
                      f"{rep.name} (warm prefix {hit} tokens)")
         return req
+
+    def resubmit(self, snap, kv_state=None, on_token=None,
+                 exclude: Sequence[str] = ()) -> Request:
+        """Place a handed-off request (a
+        :class:`~deepspeed_tpu.serving.request.RequestSnapshot`) on the
+        best accepting replica — scored by the FULL history so a replica
+        holding the request's own warm prefix wins — and continue it via
+        the target scheduler's ``resubmit``.  ``exclude`` names replicas
+        that must not receive it (e.g. the one it just left)."""
+        history = snap.history
+        ranked = [(s, h, l, rep) for s, h, l, rep in self._ranked(history)
+                  if rep.name not in exclude]
+        if not ranked:
+            raise RuntimeError(
+                f"router: no replica can take handed-off request "
+                f"{snap.uid} (excluded: {list(exclude)})")
+        _, hit, _, rep = ranked[0]
+        req = rep.scheduler.resubmit(snap, kv_state=kv_state,
+                                     on_token=on_token)
+        req.tenant = snap.tenant
+        req.replica = rep.name
+        if snap.tenant is not None:
+            self._live(snap.tenant)
+            self._tenant_live.setdefault(snap.tenant, []).append(req)
+        self.routed[rep.name] = self.routed.get(rep.name, 0) + 1
+        # KV-injected handoffs never attach the prefix cache (the carried
+        # KV wins) — counting the scoring hit would over-report saved
+        # prefill exactly in the disaggregated mode the bench measures
+        if hit > 0 and kv_state is None:
+            self.cache_hit_routed += 1
+            self.cache_hit_tokens += hit
+        return req
+
+    # ------------------------------------------------------------------ #
+    # Elastic replica set (fleet scale-up/down and rolling restarts)
+    # ------------------------------------------------------------------ #
+    def add_replica(self, name: str,
+                    scheduler: ContinuousBatchScheduler) -> Replica:
+        """Join a fresh replica to the placement set (elastic scale-up)."""
+        if any(r.name == name for r in self.replicas):
+            raise ValueError(f"router: replica {name!r} already present")
+        rep = Replica(name, scheduler)
+        self.replicas.append(rep)
+        self.routed.setdefault(name, 0)
+        return rep
+
+    def remove_replica(self, name: str) -> Replica:
+        """Detach a replica from placement (elastic downsize).  The
+        caller drains it (``shutdown(handoff=True)``) and feeds the
+        snapshots back through :meth:`resubmit`; its lifetime ``routed``
+        count stays in the telemetry."""
+        for i, rep in enumerate(self.replicas):
+            if rep.name == name:
+                if len(self.replicas) == 1:
+                    raise ValueError(
+                        "router: cannot remove the last replica")
+                return self.replicas.pop(i)
+        raise ValueError(f"router: unknown replica {name!r}")
+
+    def replace_replica(self, name: str,
+                        scheduler: ContinuousBatchScheduler) -> Replica:
+        """Swap a replica's scheduler in place (rolling restart respawn:
+        same name, fresh engine from checkpointed state)."""
+        for rep in self.replicas:
+            if rep.name == name:
+                rep.scheduler = scheduler
+                return rep
+        raise ValueError(f"router: unknown replica {name!r}")
 
     @property
     def num_pending(self) -> int:

@@ -139,15 +139,17 @@ class ContinuousBatchScheduler:
                 f"submit: admission queue full ({len(self._queued)} waiting, "
                 f"max_queue={self.max_queue}) — request {request.uid} "
                 "rejected; retry after the queue drains")
-        if len(request.prompt) + 1 > self.max_context:
+        # history, not prompt: a resubmitted (handed-off) request carries
+        # already-generated tokens that need KV room too
+        if len(request.history) + 1 > self.max_context:
             raise ValueError(
-                f"submit: prompt of {len(request.prompt)} tokens cannot fit "
-                f"max_context {self.max_context} with room to generate")
+                f"submit: history of {len(request.history)} tokens cannot "
+                f"fit max_context {self.max_context} with room to generate")
         sm = self.engine.state_manager
-        prompt_blocks = -(-(len(request.prompt) + 1) // sm.block_size)
-        if prompt_blocks > sm.allocator.num_blocks - 1:
+        hist_blocks = -(-(len(request.history) + 1) // sm.block_size)
+        if hist_blocks > sm.allocator.num_blocks - 1:
             raise ValueError(
-                f"submit: prompt needs {prompt_blocks} KV blocks but the "
+                f"submit: history needs {hist_blocks} KV blocks but the "
                 f"pool only has {sm.allocator.num_blocks - 1} usable")
         self._queued.append(request)
         self._live_uids.add(request.uid)
@@ -188,6 +190,14 @@ class ContinuousBatchScheduler:
     @property
     def running_uids(self) -> List[int]:
         return list(self._running)
+
+    @property
+    def running_decode_uids(self) -> List[int]:
+        """Running requests whose prefill completed (state DECODE) — the
+        disaggregated fleet migrates exactly these off a prefill replica,
+        KV in hand, the tick they finish prefilling."""
+        return [r.uid for r in self._running.values()
+                if r.state is RequestState.DECODE]
 
     # ------------------------------------------------------------------ #
     # One scheduling tick
@@ -381,8 +391,11 @@ class ContinuousBatchScheduler:
                      f"({len(req.generated)} tokens generated)")
 
     def _fail(self, req: Request, reason: str) -> None:
-        if req.uid in self._running:
+        # a QUEUED request can hold engine state too: resubmit() with a KV
+        # payload injects the sequence before admission packs it
+        if self.engine.state_manager.get_sequence(req.uid) is not None:
             self.engine.flush([req.uid])
+        if req.uid in self._running:
             del self._running[req.uid]
         if req in self._queued:
             self._queued.remove(req)
@@ -545,15 +558,36 @@ class ContinuousBatchScheduler:
                 time.sleep(min(arrivals[len(reqs)] - now, poll_s))
         return reqs
 
-    def shutdown(self, drain_deadline: float = 30.0) -> bool:
+    def shutdown(self, drain_deadline: float = 30.0, handoff: bool = False):
         """Graceful shutdown: close admission immediately (``submit``
-        raises from now on), let in-flight work finish via :meth:`drain`,
-        then fail whatever is still pending with reason ``"shutdown"``
-        (counted in the ``serving/shutdown_failed`` metric).  Returns True
-        when everything drained within ``drain_deadline`` seconds —
-        nothing was dropped."""
+        raises from now on) and let in-flight work finish via
+        :meth:`drain`.
+
+        ``handoff=False`` (the default): whatever is still pending after
+        ``drain_deadline`` seconds is failed with reason ``"shutdown"``
+        (counted in ``serving/shutdown_failed``).  Returns True when
+        everything drained — nothing was dropped.
+
+        ``handoff=True`` (rolling restarts / elastic downsize): pending
+        requests are DETACHED instead of failed — each becomes a
+        serializable :class:`~deepspeed_tpu.serving.request.RequestSnapshot`
+        (tokens emitted, sampler seed, tenant/priority/remaining deadline)
+        that another replica's :meth:`resubmit` continues token-exactly.
+        Returns ``(drained, snapshots)``; ``snapshots`` is empty when the
+        drain completed in time."""
         self._shutting_down = True
         idle = self.drain(drain_deadline)
+        if handoff:
+            snaps = []
+            if not idle:
+                leftovers = [*self._queued, *list(self._running.values()),
+                             *self._preempted]
+                logger.info(
+                    f"serving: shutdown drain deadline ({drain_deadline}s) "
+                    f"expired — handing off {len(leftovers)} request(s)")
+                snaps = [self._detach(req)[0] for req in leftovers]
+                self._export_metrics()
+            return idle, snaps
         if not idle:
             leftovers = [*self._queued, *list(self._running.values()),
                          *self._preempted]
@@ -565,6 +599,93 @@ class ContinuousBatchScheduler:
                 self._fail(req, "shutdown")
             self._export_metrics()
         return idle
+
+    # ------------------------------------------------------------------ #
+    # Cross-replica handoff (the fleet layer's migration primitive)
+    # ------------------------------------------------------------------ #
+    @property
+    def accepting_submissions(self) -> bool:
+        """False once :meth:`shutdown` closed admission (a router skips
+        draining replicas)."""
+        return not self._shutting_down
+
+    def _detach(self, req: Request, include_kv: bool = False):
+        """Remove ``req`` from every scheduler structure and return
+        ``(snapshot, kv_state)`` — the request continues elsewhere as a
+        NEW object; this one transitions to the terminal ``HANDED_OFF``
+        (so tenant-quota views prune it, and a holder sees it is gone).
+        ``include_kv=True`` (running requests only) carries the device KV
+        along so the target replica skips the recompute re-prefill."""
+        kv_state = None
+        fed = 0
+        if req.uid in self._running:
+            if include_kv and hasattr(self.engine, "flush_to_host"):
+                kv_state = self.engine.flush_to_host(
+                    [req.uid], include_kv=True)[req.uid]
+                fed = kv_state["seen_tokens"]
+            else:
+                self.engine.flush_to_host([req.uid])
+            del self._running[req.uid]
+            req.fed = 0
+        elif self.engine.state_manager.get_sequence(req.uid) is not None:
+            # an injected-KV request still queued: release its blocks
+            self.engine.flush([req.uid])
+        if req in self._queued:
+            self._queued.remove(req)
+            self._parked_backlog -= self._work(req)
+        elif req in self._preempted:
+            self._preempted.remove(req)
+            self._parked_backlog -= self._work(req)
+        self._live_uids.discard(req.uid)
+        snap = req.snapshot(fed_tokens=fed)
+        req.finish_reason = "handoff"
+        req.transition(RequestState.HANDED_OFF)
+        self.metrics.record_handoff(req)
+        return snap, kv_state
+
+    def extract_for_handoff(self, uid: int, include_kv: bool = False):
+        """Detach one live request for migration to another replica.
+        Returns ``(snapshot, kv_state)``; ``kv_state`` is the
+        ``flush_to_host(include_kv=True)`` payload when requested and the
+        request was running (None otherwise).  The disaggregated
+        prefill→decode pump calls this the tick a prefill completes."""
+        for req in [*self._running.values(), *self._queued,
+                    *self._preempted]:
+            if req.uid == uid:
+                return self._detach(req, include_kv=include_kv)
+        raise ValueError(f"extract_for_handoff: uid {uid} is not live")
+
+    def resubmit(self, snap, kv_state=None, on_token=None) -> Request:
+        """Continue a handed-off request on THIS replica.
+
+        Reconstructs a :class:`Request` from ``snap`` (uid preserved — it
+        keys the sampling noise stream) and submits it.  Without
+        ``kv_state`` the request re-prefills ``prompt + generated``
+        (recompute, warm prefix blocks re-attach via the radix cache when
+        enabled).  With ``kv_state`` the carried KV is injected through
+        ``engine.resume(..., kv_state=...)`` so only the unfed tail is
+        ever recomputed; when the KV no longer fits this replica's pool
+        the payload is dropped and the request falls back to recompute —
+        a handoff may get slower, never lost."""
+        req = snap.to_request(on_token=on_token)
+        injected = False
+        if kv_state is not None and hasattr(self.engine, "resume"):
+            sm = self.engine.state_manager
+            seen = min(int(kv_state["seen_tokens"]), len(req.history) - 1)
+            need = -(-seen // sm.block_size) if seen > 0 else 0
+            if seen > 0 and need <= sm.free_blocks \
+                    and sm.get_sequence(req.uid) is None \
+                    and not self._shutting_down:
+                self.engine.resume(req.uid, req.history[:seen],
+                                   kv_state=kv_state)
+                req.fed = seen
+                injected = True
+        try:
+            return self.submit(request=req)
+        except Exception:
+            if injected:
+                self.engine.flush([req.uid])
+            raise
 
     def drain(self, deadline: float) -> bool:
         """Async-friendly bounded drain: step until idle or ``deadline``
