@@ -22,14 +22,27 @@ Process-separated replicas under per-replica ``JobSupervisor``s live in
 :mod:`deepspeed_tpu.fleet.worker` (:class:`FleetFrontEnd` /
 :func:`run_replica_worker`); ``tools/fleet_smoke.py`` SIGKILLs one
 mid-decode and proves zero requests are lost.
+
+Defense in depth (:mod:`deepspeed_tpu.fleet.defense`): poison-request
+quarantine (:class:`CrashBlame`), per-replica circuit breakers
+(:class:`CircuitBreaker`), and fleet-level overload backpressure
+(:class:`AdmissionBudget` → :class:`OverloadShedError` with retry-after
+hints), all driven deterministically by the ``poison_request`` /
+``tick_stall`` / ``spawn_fail`` chaos fault points.
 """
 
+from deepspeed_tpu.fleet.defense import (AdmissionBudget, BreakerState,
+                                         CircuitBreaker, CrashBlame,
+                                         OverloadShedError,
+                                         QuarantinedError)
 from deepspeed_tpu.fleet.elastic import FleetAutoscaler
 from deepspeed_tpu.fleet.fleet import (FleetRequest, SchedulerFactory,
                                        ServingFleet)
 from deepspeed_tpu.fleet.metrics import FleetMetrics
 from deepspeed_tpu.fleet.worker import FleetFrontEnd, run_replica_worker
 
-__all__ = ["FleetAutoscaler", "FleetFrontEnd", "FleetMetrics",
-           "FleetRequest", "SchedulerFactory", "ServingFleet",
+__all__ = ["AdmissionBudget", "BreakerState", "CircuitBreaker",
+           "CrashBlame", "FleetAutoscaler", "FleetFrontEnd",
+           "FleetMetrics", "FleetRequest", "OverloadShedError",
+           "QuarantinedError", "SchedulerFactory", "ServingFleet",
            "run_replica_worker"]

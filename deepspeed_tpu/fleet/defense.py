@@ -1,0 +1,404 @@
+"""Fleet defense-in-depth: the policy pieces that let a serving fleet
+survive hostile inputs and sick replicas instead of cascading.
+
+PR 7's zero-loss replay is a liability as well as a feature: the fleet
+replays *every* in-flight request onto a respawned replica, so a single
+malformed "poison" request that deterministically crashes the engine
+would crash-loop the replica until the restart budget exhausts, taking
+every innocent co-batched request down with it.  This module is the
+standard production answer, owned in-repo:
+
+* :class:`CrashBlame` — **poison-request quarantine**.  The fleet
+  journals the exact in-flight set at each replica incarnation death;
+  requests are scored by co-occurrence across deaths.  Past
+  ``suspect_after`` co-occurrences a request is *suspect* and the fleet
+  bisects the replay set: suspects are replayed **in isolation** on the
+  respawned replica (innocents route elsewhere), so the next death has a
+  singleton in-flight set and convicts the poison request —
+  terminalized ``FAILED reason="quarantined"`` with a tenant-visible
+  error instead of being replayed forever.
+
+* :class:`CircuitBreaker` — **per-replica circuit breaking**.  Repeated
+  respawn failures, or deaths inside the startup window after a respawn,
+  open the breaker: the replica leaves router placement and only a
+  half-open probe after ``cooloff_s`` may bring it back (cooloff grows
+  per re-open).  A bad host degrades capacity; it does not eat the
+  fleet's restart budget.
+
+* :class:`AdmissionBudget` — **fleet-level overload backpressure**.  A
+  shared queue-depth and/or token-rate budget ahead of the router that
+  sheds the lowest :class:`~deepspeed_tpu.serving.router.PriorityClass`
+  first (each class may only fill its *ceiling* fraction of the budget)
+  and attaches a ``retry_after_s`` hint to every shed.  It composes
+  with — does not duplicate — the router's per-replica SLO admission:
+  this gate bounds what the *fleet* accepts; the SLO gate predicts
+  whether a *replica* can meet one request's deadline.
+
+Everything here is host-side pure policy with injectable clocks, so
+tests drive it with synthetic death/traffic traces; the chaos fault
+points ``poison_request`` / ``tick_stall`` / ``spawn_fail`` drive the
+integrated behavior deterministically end-to-end.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from collections import deque
+from typing import (Callable, Deque, Dict, List, Optional, Sequence, Set,
+                    Tuple)
+
+
+class QuarantinedError(RuntimeError):
+    """Tenant-visible terminal error: the request was convicted as a
+    poison request (it kept crashing replicas) and will not be retried."""
+
+
+class OverloadShedError(RuntimeError):
+    """``submit()`` shed by the fleet's overload-backpressure gate.  The
+    fleet is over its admission budget for this request's priority
+    class; retry after ``retry_after_s`` (lower classes shed first, so
+    upgrading the class may also admit sooner)."""
+
+    def __init__(self, msg: str, retry_after_s: float, shed_class: str):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+        self.shed_class = shed_class
+
+
+# --------------------------------------------------------------------- #
+# Crash blame: co-occurrence scoring -> isolation -> conviction
+# --------------------------------------------------------------------- #
+class CrashBlame:
+    """Attributes replica deaths to the requests that were in flight.
+
+    Nothing in a crash names its culprit (the engine is gone), so blame
+    is statistical: every death records its in-flight uid set (the
+    journal), and a uid present at ``suspect_after`` deaths becomes a
+    *suspect* the fleet must probe in isolation.  A death whose
+    in-flight set is a **singleton** uid with at least ``convict_after``
+    recorded deaths convicts that uid — by then the request has crashed
+    a replica it had all to itself, which no flaky host explains.  A
+    singleton death that was NOT a deliberate isolation probe needs one
+    death more (``convict_after + 1``): two environmental stalls or
+    operator kills of a replica holding one lone request must make it a
+    suspect (and send it to a probe), not quarantine an innocent.
+    ``absolve`` clears a suspect that survived its isolation probe (the
+    co-occurrences were bad luck, not causation)."""
+
+    def __init__(self, suspect_after: int = 2, convict_after: int = 2,
+                 journal_cap: int = 256):
+        if suspect_after < 1 or convict_after < 1:
+            raise ValueError(
+                f"blame thresholds must be >= 1 (suspect_after="
+                f"{suspect_after}, convict_after={convict_after})")
+        self.suspect_after = suspect_after
+        self.convict_after = convict_after
+        #: the journal: one record per incarnation death, exact in-flight
+        #: set — bounded, so a chaos-ridden long-running fleet does not
+        #: grow host memory per death (the score table tracks live uids
+        #: only, via forget/absolve)
+        self.deaths: Deque[dict] = deque(maxlen=journal_cap)
+        self._counts: Dict[int, int] = {}
+        self._absolved: Set[int] = set()
+
+    def record_death(self, uids: Sequence[int], replica: str = "",
+                     reason: str = "crash") -> None:
+        """Journal one incarnation death with its exact in-flight set."""
+        uids = sorted(set(int(u) for u in uids))
+        self.deaths.append({"t": time.time(), "replica": replica,
+                            "reason": reason, "uids": uids})
+        for u in uids:
+            self._absolved.discard(u)      # new evidence reopens the case
+            self._counts[u] = self._counts.get(u, 0) + 1
+
+    def death_count(self, uid: int) -> int:
+        return self._counts.get(uid, 0)
+
+    def is_suspect(self, uid: int) -> bool:
+        return (uid not in self._absolved
+                and self._counts.get(uid, 0) >= self.suspect_after)
+
+    def suspects(self) -> List[int]:
+        return sorted(u for u in self._counts if self.is_suspect(u))
+
+    def convict(self, death_uids: Sequence[int],
+                probed: bool = False) -> Optional[int]:
+        """The uid convicted by this death's in-flight set, or None.
+        Only a singleton set convicts — co-batched deaths are ambiguous
+        and feed the suspect scores instead.  ``probed`` marks the death
+        of a deliberate isolation probe, the strongest evidence; an
+        un-probed singleton needs ``convict_after + 1`` deaths so that
+        repeated environmental kills of a lone request escalate it to a
+        probe instead of quarantining an innocent."""
+        uids = set(death_uids)
+        if len(uids) != 1:
+            return None
+        (uid,) = uids
+        bar = self.convict_after if probed else self.convict_after + 1
+        if self._counts.get(uid, 0) >= bar:
+            return uid
+        return None
+
+    def classify_lost(self, death_uids: Sequence[int],
+                      probed: bool = False
+                      ) -> Tuple[Optional[int], List[int], List[int]]:
+        """The shared post-death partition both death paths (in-process
+        ``ServingFleet`` and subprocess ``FleetFrontEnd``) apply to the
+        lost set: ``(convicted uid or None, suspects, innocents)``.
+        Call AFTER :meth:`record_death` for the same set."""
+        convicted = self.convict(death_uids, probed=probed)
+        suspects: List[int] = []
+        innocents: List[int] = []
+        for uid in death_uids:
+            if uid == convicted:
+                continue
+            (suspects if self.is_suspect(uid) else innocents).append(uid)
+        return convicted, suspects, innocents
+
+    def absolve(self, uid: int) -> None:
+        """The suspect finished cleanly in isolation: clear its record so
+        fresh co-occurrences start from zero."""
+        self._counts.pop(uid, None)
+        self._absolved.add(uid)
+
+    def verdict(self, uid: int, host_kind: str = "replica") -> str:
+        """The tenant-visible conviction message — one wording for both
+        the in-process and subprocess death paths."""
+        return (f"request {uid} quarantined as a poison request: in "
+                f"flight at {self.death_count(uid)} {host_kind} deaths "
+                f"and crashed a {host_kind} it had in isolation — "
+                f"terminal, will not be retried")
+
+    def forget(self, uid: int) -> None:
+        """Drop a terminal uid's score (quarantined or failed elsewhere)
+        so a long-running fleet's score table stays bounded by the live
+        set, not the lifetime request count."""
+        self._counts.pop(uid, None)
+        self._absolved.discard(uid)
+
+
+# --------------------------------------------------------------------- #
+# Per-replica circuit breaker
+# --------------------------------------------------------------------- #
+class BreakerState(enum.Enum):
+    CLOSED = "closed"          # healthy: in placement
+    OPEN = "open"              # tripped: out of placement, cooling off
+    HALF_OPEN = "half_open"    # cooloff elapsed: one probe allowed
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probing.
+
+    ``record_failure`` past ``failure_threshold`` opens the breaker;
+    while OPEN, :meth:`allows` is False (the router drops the replica
+    from placement, the fleet stops respawn attempts).  After
+    ``cooloff_s`` the state reads HALF_OPEN and one probe may run; a
+    probe failure re-opens with the cooloff stretched by
+    ``cooloff_factor`` (capped at ``max_cooloff_s``), a success closes
+    and resets everything.  The clock is injectable for tests."""
+
+    def __init__(self, failure_threshold: int = 2, cooloff_s: float = 10.0,
+                 cooloff_factor: float = 2.0, max_cooloff_s: float = 300.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1 or cooloff_s <= 0 or cooloff_factor < 1.0:
+            raise ValueError(
+                f"invalid breaker: failure_threshold={failure_threshold} "
+                f"cooloff_s={cooloff_s} cooloff_factor={cooloff_factor}")
+        self.failure_threshold = failure_threshold
+        self.base_cooloff_s = cooloff_s
+        self.cooloff_s = cooloff_s
+        self.cooloff_factor = cooloff_factor
+        self.max_cooloff_s = max_cooloff_s
+        self._clock = clock
+        self.failures = 0
+        self.opens = 0                 # lifetime open transitions
+        self._opened_at: Optional[float] = None
+
+    @property
+    def state(self) -> BreakerState:
+        if self._opened_at is None:
+            return BreakerState.CLOSED
+        if self._clock() - self._opened_at >= self.cooloff_s:
+            return BreakerState.HALF_OPEN
+        return BreakerState.OPEN
+
+    def allows(self) -> bool:
+        """May this replica take placement / a respawn probe right now?"""
+        return self.state is not BreakerState.OPEN
+
+    def record_failure(self) -> bool:
+        """One replica-attributable failure (respawn failed, or death in
+        the startup window).  Returns True when this call OPENED the
+        breaker."""
+        half_open = self.state is BreakerState.HALF_OPEN
+        self.failures += 1
+        if half_open:
+            # the probe failed: re-open immediately, longer cooloff
+            self.cooloff_s = min(self.cooloff_s * self.cooloff_factor,
+                                 self.max_cooloff_s)
+            self._opened_at = self._clock()
+            self.opens += 1
+            return True
+        if self._opened_at is None and \
+                self.failures >= self.failure_threshold:
+            self._opened_at = self._clock()
+            self.opens += 1
+            return True
+        return False
+
+    def trip(self) -> bool:
+        """Force-open (e.g. the fleet restart budget is exhausted: stop
+        respawning regardless of this replica's own record).  Returns
+        True only when this call newly opened the breaker — repeated
+        trips while already open are not new opens (telemetry must not
+        report a flap that never happened)."""
+        newly = self._opened_at is None
+        if newly:
+            self.opens += 1
+        self.failures = max(self.failures, self.failure_threshold)
+        self._opened_at = self._clock()
+        return newly
+
+    def record_success(self) -> None:
+        """The replica proved healthy (survived the startup window):
+        close and reset."""
+        self.failures = 0
+        self.cooloff_s = self.base_cooloff_s
+        self._opened_at = None
+
+
+# --------------------------------------------------------------------- #
+# Fleet-level overload backpressure
+# --------------------------------------------------------------------- #
+#: each class may fill at most this fraction of the admission budget, so
+#: under overload the lowest class hits its ceiling (and sheds) first
+#: while interactive traffic still has headroom
+DEFAULT_CLASS_CEILINGS: Dict[str, float] = {
+    "interactive": 1.0,
+    "standard": 0.85,
+    "batch": 0.5,
+}
+
+
+class AdmissionBudget:
+    """Shared fleet-wide admission budget ahead of the router.
+
+    Two independent gates, either or both:
+
+    * **queue depth** — a request of ``cost`` tokens is admitted only
+      while ``backlog + cost <= ceiling(class) * max_backlog_tokens``;
+    * **token rate** — a token bucket of ``admit_tokens_per_s`` with
+      ``burst_tokens`` capacity; class ``c`` may only draw the bucket
+      down to ``(1 - ceiling(c)) * burst`` (batch cannot drain the
+      tokens interactive would need).
+
+    Sheds raise :class:`OverloadShedError` with a ``retry_after_s`` hint
+    derived from the drain rate (queue gate) or refill rate (rate gate).
+    """
+
+    def __init__(self, max_backlog_tokens: Optional[float] = None,
+                 admit_tokens_per_s: Optional[float] = None,
+                 burst_tokens: Optional[float] = None,
+                 class_ceilings: Optional[Dict[str, float]] = None,
+                 default_ceiling: float = 0.85,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_backlog_tokens is None and admit_tokens_per_s is None:
+            raise ValueError(
+                "AdmissionBudget needs max_backlog_tokens and/or "
+                "admit_tokens_per_s")
+        for v in (max_backlog_tokens, admit_tokens_per_s, burst_tokens):
+            if v is not None and v <= 0:
+                raise ValueError(f"budget values must be > 0 (got {v})")
+        self.max_backlog_tokens = max_backlog_tokens
+        self.admit_tokens_per_s = admit_tokens_per_s
+        self.burst_tokens = (burst_tokens if burst_tokens is not None
+                             else (admit_tokens_per_s or 0.0) * 2.0)
+        self.class_ceilings = dict(class_ceilings
+                                   if class_ceilings is not None
+                                   else DEFAULT_CLASS_CEILINGS)
+        if not 0.0 < default_ceiling <= 1.0 or any(
+                not 0.0 < c <= 1.0 for c in self.class_ceilings.values()):
+            raise ValueError("class ceilings must be in (0, 1]")
+        self.default_ceiling = default_ceiling
+        self._clock = clock
+        self._level = self.burst_tokens      # bucket starts full
+        self._last = clock()
+        # telemetry
+        self.admitted = 0
+        self.shed_total = 0
+        self.shed_by_class: Dict[str, int] = {}
+
+    def ceiling(self, priority_class: Optional[str]) -> float:
+        if priority_class is None:
+            return self.default_ceiling
+        return self.class_ceilings.get(priority_class, self.default_ceiling)
+
+    def _shed(self, cls: str, msg: str, retry_after_s: float) -> None:
+        self.shed_total += 1
+        self.shed_by_class[cls] = self.shed_by_class.get(cls, 0) + 1
+        raise OverloadShedError(
+            f"{msg} — shed (class={cls}); retry after "
+            f"~{retry_after_s:.2f}s", max(retry_after_s, 1e-3), cls)
+
+    def admit(self, cost_tokens: float,
+              priority_class: Optional[str] = None,
+              backlog_tokens: float = 0.0,
+              drain_tokens_per_s: Optional[float] = None) -> None:
+        """Gate one request of ``cost_tokens`` (prompt + generation
+        budget).  ``backlog_tokens`` is the fleet's current outstanding
+        work; ``drain_tokens_per_s`` (measured fleet goodput) sharpens
+        the retry-after hint.  Raises :class:`OverloadShedError`."""
+        cls = priority_class if priority_class is not None else "default"
+        ceil = self.ceiling(priority_class)
+        if self.max_backlog_tokens is not None:
+            allowed = ceil * self.max_backlog_tokens
+            if backlog_tokens + cost_tokens > allowed:
+                rate = drain_tokens_per_s or self.admit_tokens_per_s or 0.0
+                excess = backlog_tokens + cost_tokens - allowed
+                retry = excess / rate if rate > 0 else 1.0
+                self._shed(cls,
+                           f"fleet backlog {backlog_tokens:.0f} + "
+                           f"{cost_tokens:.0f} tokens exceeds the class "
+                           f"budget {allowed:.0f} "
+                           f"(= {ceil:.2f} x {self.max_backlog_tokens:.0f})",
+                           retry)
+        if self.admit_tokens_per_s is not None:
+            now = self._clock()
+            self._level = min(self.burst_tokens,
+                              self._level
+                              + (now - self._last) * self.admit_tokens_per_s)
+            self._last = now
+            floor = (1.0 - ceil) * self.burst_tokens
+            if self._level - cost_tokens < floor:
+                need = cost_tokens + floor - self._level
+                retry = need / self.admit_tokens_per_s
+                self._shed(cls,
+                           f"admission rate budget: bucket at "
+                           f"{self._level:.0f}/{self.burst_tokens:.0f} "
+                           f"tokens, class floor {floor:.0f}, request "
+                           f"needs {cost_tokens:.0f}", retry)
+            self._level -= cost_tokens
+        self.admitted += 1
+
+    def refund(self, cost_tokens: float) -> None:
+        """Return an admitted request's tokens: it never entered the
+        fleet (the router's quota/SLO/queue gate rejected it after this
+        budget had already charged the bucket).  Without the refund a
+        tenant retry-looping against its quota would drain the shared
+        rate budget with requests that serve nothing."""
+        if self.admit_tokens_per_s is not None:
+            self._level = min(self.burst_tokens,
+                              self._level + cost_tokens)
+        self.admitted = max(self.admitted - 1, 0)
+
+    def snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = {
+            "admitted": float(self.admitted),
+            "shed_total": float(self.shed_total),
+        }
+        for cls, n in self.shed_by_class.items():
+            out[f"shed_{cls}"] = float(n)
+        if self.admit_tokens_per_s is not None:
+            out["bucket_level"] = float(self._level)
+        return out

@@ -35,7 +35,21 @@ This is the integration layer the last three subsystems were built for:
   replica (``engine.resume(kv_state=...)``) — DeepSpeed-FastGen's
   SplitFuse taken to its disaggregated conclusion: a long prefill
   saturates a prefill replica's tick, never the decode pool's, and the
-  migrated KV makes decode tokens bit-identical to the colocated path.
+  migrated KV makes decode tokens bit-identical to the colocated path;
+* **defense in depth** (see :mod:`deepspeed_tpu.fleet.defense`) — an
+  in-process replica death (engine crash, tick-watchdog trip) is caught
+  at the fleet tick and attributed: the journal records the exact
+  in-flight set per death, a :class:`CrashBlame` tracker scores
+  co-occurrence, suspects are replayed in **isolation** on the
+  respawned replica, and a convicted poison request is terminalized
+  ``FAILED reason="quarantined"`` instead of crash-looping the fleet.
+  Respawns draw from a :class:`RestartBudget` behind a per-replica
+  :class:`CircuitBreaker` (repeated respawn failures / startup-window
+  deaths open it; half-open probes bring a recovered replica back), a
+  ``max_replays`` cap bounds even unconvicted replays
+  (``reason="replay_budget"``), and an optional
+  :class:`AdmissionBudget` sheds overload lowest-priority-class-first
+  in front of the router with retry-after hints.
 """
 
 from __future__ import annotations
@@ -45,12 +59,19 @@ import itertools
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from deepspeed_tpu.fleet.defense import (AdmissionBudget, BreakerState,
+                                         CircuitBreaker, CrashBlame,
+                                         OverloadShedError)
 from deepspeed_tpu.fleet.elastic import FleetAutoscaler
 from deepspeed_tpu.fleet.metrics import FleetMetrics
+from deepspeed_tpu.resilience import chaos
+from deepspeed_tpu.resilience.chaos import ChaosInjectedError
+from deepspeed_tpu.resilience.supervisor import RestartBudget
 from deepspeed_tpu.serving.request import (Request, RequestSnapshot,
                                            RequestState, SamplingParams)
 from deepspeed_tpu.serving.router import CacheAwareRouter, Replica
-from deepspeed_tpu.serving.scheduler import ContinuousBatchScheduler
+from deepspeed_tpu.serving.scheduler import (ContinuousBatchScheduler,
+                                             TickDeadlineError)
 from deepspeed_tpu.utils.logging import logger
 
 #: scheduler_factory(name) -> a fresh ContinuousBatchScheduler (engine
@@ -76,6 +97,8 @@ class FleetRequest:
     tokens: List[int] = dataclasses.field(default_factory=list)
     state: str = "live"                  # live | finished | failed
     finish_reason: Optional[str] = None
+    #: tenant-visible terminal error detail (e.g. the quarantine verdict)
+    error: Optional[str] = None
     arrival: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
@@ -88,6 +111,22 @@ class FleetRequest:
     @property
     def done(self) -> bool:
         return self.state != "live"
+
+    def check(self) -> None:
+        """Raise this request's terminal error, if any — the
+        tenant-visible surface for defense-in-depth verdicts:
+        :class:`~deepspeed_tpu.fleet.defense.QuarantinedError` for a
+        quarantined poison request, RuntimeError for other failures.
+        No-op while live or finished."""
+        if self.state != "failed":
+            return
+        from deepspeed_tpu.fleet.defense import QuarantinedError
+
+        msg = self.error or f"request {self.uid} failed: " \
+                            f"{self.finish_reason}"
+        if self.finish_reason == "quarantined":
+            raise QuarantinedError(msg)
+        raise RuntimeError(msg)
 
     @property
     def generated(self) -> List[int]:
@@ -141,7 +180,13 @@ class ServingFleet:
                  metrics: Optional[FleetMetrics] = None,
                  monitor=None,
                  time_handoffs: bool = True,
-                 keep_finished: Optional[int] = None):
+                 keep_finished: Optional[int] = None,
+                 max_replays: int = 5,
+                 blame: Optional[CrashBlame] = None,
+                 breaker_kwargs: Optional[dict] = None,
+                 restart_budget: Optional[RestartBudget] = None,
+                 startup_window_s: float = 5.0,
+                 admission: Optional[AdmissionBudget] = None):
         if (prefill_replicas > 0) != (decode_replicas > 0):
             raise ValueError(
                 "disaggregation needs BOTH prefill_replicas and "
@@ -198,10 +243,46 @@ class ServingFleet:
         #: pool's dispatch pipeline fully async
         self.time_handoffs = time_handoffs
         self._tick = 0
+        # -- defense in depth ------------------------------------------- #
+        if max_replays < 1:
+            raise ValueError("max_replays must be >= 1")
+        #: crash-replay cap per request: past it the request is failed
+        #: reason="replay_budget" — even an unconvicted request cannot
+        #: replay unboundedly
+        self.max_replays = max_replays
+        #: crash blame / poison quarantine (see fleet.defense)
+        self.blame = blame if blame is not None else CrashBlame()
+        self._breaker_kwargs = dict(breaker_kwargs or {})
+        #: fleet-wide respawn budget: successful respawns draw from it;
+        #: exhausted, replicas stay broken (breaker force-opened) until
+        #: the window slides — capacity degrades, the fleet survives
+        self.restart_budget = restart_budget if restart_budget is not None \
+            else RestartBudget(max_restarts=8, window_s=120.0)
+        #: a death within this window after a respawn counts against the
+        #: replica's breaker (bad binary/host); surviving past it closes
+        #: the breaker again
+        self.startup_window_s = float(startup_window_s)
+        #: fleet-level overload backpressure gate (None = admit all);
+        #: sheds lowest priority class first BEFORE the router's
+        #: per-replica SLO admission ever sees the request
+        self.admission = admission
+        self._respawned_at: Dict[str, float] = {}
+        #: poison-suspect uids awaiting an isolation probe, FIFO
+        self._suspect_queue: List[int] = []
+        #: replica name -> uid probed in isolation there
+        self._probe: Dict[str, int] = {}
+        for _, rep in self.pool_members():
+            self._install_defenses(rep)
 
     # ------------------------------------------------------------------ #
     # Topology
     # ------------------------------------------------------------------ #
+    def _install_defenses(self, rep: Replica) -> None:
+        """Every replica gets its own circuit breaker (fresh history —
+        a new name is a new host)."""
+        if rep.breaker is None:
+            rep.breaker = CircuitBreaker(**self._breaker_kwargs)
+
     def _next_name(self, prefix: str) -> str:
         ctr = self._name_counters.setdefault(prefix, itertools.count())
         return f"{prefix}{next(ctr)}"
@@ -258,15 +339,45 @@ class ServingFleet:
         """Admit one request through the front door (quota / priority /
         SLO gates, cache-affine placement).  Returns the durable
         :class:`FleetRequest` handle; ``on_token(fleet_request, token)``
-        streams every token across replica incarnations."""
+        streams every token across replica incarnations.  With an
+        :class:`AdmissionBudget` installed, overload sheds the request
+        here (:class:`OverloadShedError` with a retry-after hint),
+        lowest priority class first, before the router's per-replica
+        SLO gate ever scores it."""
+        cost = 0.0
+        if self.admission is not None:
+            sp = sampling if sampling is not None else SamplingParams()
+            cost = float(len(prompt) + sp.max_new_tokens)
+            backlog = sum(rep.load_tokens()
+                          for _, rep in self.pool_members()
+                          if not rep.broken)
+            drain = sum(rep.scheduler.metrics.goodput_tokens_per_s()
+                        for _, rep in self.pool_members()
+                        if not rep.broken)
+            try:
+                self.admission.admit(cost, priority_class=priority_class,
+                                     backlog_tokens=backlog,
+                                     drain_tokens_per_s=drain or None)
+            except OverloadShedError as e:
+                self.metrics.record_shed(e.shed_class)
+                raise
         uid = next(self._uid_counter)
         fr = FleetRequest(uid=uid, prompt=[int(t) for t in prompt],
                           sampling=sampling or SamplingParams(),
                           tenant=tenant, on_token=on_token)
-        req = self.router.submit(
-            fr.prompt, tenant=tenant, priority_class=priority_class,
-            priority=priority, deadline_s=deadline_s,
-            sampling=fr.sampling, on_token=self._hook(fr), uid=uid)
+        try:
+            req = self.router.submit(
+                fr.prompt, tenant=tenant, priority_class=priority_class,
+                priority=priority, deadline_s=deadline_s,
+                sampling=fr.sampling, on_token=self._hook(fr), uid=uid)
+        except Exception:
+            # the router's own gates (quota / SLO / queue bound) rejected
+            # it AFTER the overload budget was charged: give the tokens
+            # back — a tenant retry-looping on its quota must not drain
+            # the shared rate budget for everyone else
+            if self.admission is not None:
+                self.admission.refund(cost)
+            raise
         fr.priority = req.priority
         fr.deadline_s = req.deadline_s
         fr.replicas.append(req.replica)
@@ -282,18 +393,42 @@ class ServingFleet:
         work runs one scheduler tick, completed prefills migrate to the
         decode pool (disaggregated mode), finishes are collected into the
         journal, and the autoscaler gets its observation.  Returns the
-        number of tokens emitted fleet-wide this tick."""
+        number of tokens emitted fleet-wide this tick.
+
+        This is also where defense-in-depth runs: a replica tick that
+        RAISES (engine crash, tick-watchdog trip) is an in-process
+        incarnation death — the replica is respawned and its in-flight
+        set blamed/replayed exactly as a SIGKILL would be handled;
+        broken replicas get half-open breaker respawn probes; poison
+        suspects get their isolation probes."""
         emitted = 0
+        self._close_recovered_breakers()
+        self._probe_broken()
         if self._parked:
             parked, self._parked = self._parked, []
             for snap in parked:
                 self._place(snap)
         for _, rep in list(self.pool_members()):
-            if rep.num_pending:
+            if rep.broken or not rep.num_pending:
+                continue
+            try:
                 emitted += len(rep.step())
+            except TickDeadlineError as e:
+                logger.warning(f"fleet: replica {rep.name} tick watchdog "
+                               f"tripped: {e}")
+                self._on_replica_death(rep.name, reason="tick_stall",
+                                       blame_uids=e.uids)
+            except Exception as e:  # noqa: BLE001 — a replica crash is
+                # survivable BY DESIGN: blame, respawn, replay
+                logger.exception(
+                    f"fleet: replica {rep.name} died in-process ({e!r}) "
+                    "— treating as an incarnation death")
+                self._on_replica_death(rep.name, reason="crash")
         if self.disaggregated:
             self._pump_handoffs()
         self._collect()
+        self._release_probes()
+        self._pump_probes()
         self._tick += 1
         if self.autoscaler is not None \
                 and self._tick % self.autoscale_every == 0:
@@ -338,7 +473,16 @@ class ServingFleet:
         import jax
 
         for rep in list(self.router.replicas):
+            if rep.broken:
+                continue
             for uid in list(rep.scheduler.running_decode_uids):
+                if self.blame.is_suspect(uid) \
+                        or self._probe.get(rep.name) == uid:
+                    # a suspect's probe stays IN ISOLATION through its
+                    # decode too — handing it to the decode pool would
+                    # co-batch it with innocents and (if it is poison)
+                    # make the next death non-singleton, unconvictable
+                    continue
                 fr = self._requests.get(uid)
                 t0 = time.perf_counter()
                 snap, kv = rep.scheduler.extract_for_handoff(
@@ -391,6 +535,8 @@ class ServingFleet:
                 fr.finish_time = time.monotonic()
                 self._n_live -= 1
                 self._finished_order.append(req.uid)
+                # terminal: the blame score table tracks LIVE uids only
+                self.blame.forget(req.uid)
             offsets[id(sched)] = len(fin)
         self._fin_offset = offsets
         if self.keep_finished is not None:
@@ -400,7 +546,7 @@ class ServingFleet:
                 self._collected.discard(uid)
 
     # ------------------------------------------------------------------ #
-    # Failure handling: respawn + zero-loss replay
+    # Failure handling: blame + respawn + quarantine + zero-loss replay
     # ------------------------------------------------------------------ #
     def kill_replica(self, name: str,
                      factory: Optional[SchedulerFactory] = None) -> int:
@@ -409,41 +555,338 @@ class ServingFleet:
         nothing is asked politely), a fresh replica is spawned from the
         factory (checkpointed engine state), and every in-flight request
         that was living there is replayed from the fleet journal onto the
-        router's best replica.  Returns the number of requests replayed —
-        zero of them are lost."""
+        router's best replica (suspects in isolation, convicted poison
+        quarantined — see :meth:`_on_replica_death`).  Returns the
+        number of requests replayed."""
+        return self._on_replica_death(name, reason="killed",
+                                      factory=factory)
+
+    def _on_replica_death(self, name: str, *, reason: str,
+                          blame_uids: Optional[Iterable[int]] = None,
+                          factory: Optional[SchedulerFactory] = None) -> int:
+        """One replica incarnation died (in-process exception, tick-
+        watchdog trip, or explicit kill).  The full defense pipeline:
+
+        1. journal the exact in-flight set into the blame tracker
+           (``blame_uids`` narrows it to the packed batch when the
+           watchdog names one);
+        2. convict if this death isolates a single repeat offender —
+           the convicted request is QUARANTINED (terminal, tenant-
+           visible), never replayed again;
+        3. charge the replica's circuit breaker when the death landed
+           inside the post-respawn startup window and blame cannot pin
+           it on a poison suspect;
+        4. respawn (budget- and breaker-gated; ``spawn_fail`` chaos
+           lands here) — a failed respawn leaves the replica ``broken``
+           until a half-open breaker probe succeeds;
+        5. replay innocents through the router, queue suspects for
+           isolation probes on the respawned replica."""
         self._collect()
-        router, rep = self._find(name)
-        # a snapshot already detached (parked for retry) still names this
-        # replica as its last home — step() owns its replay; replaying it
-        # here too would run the same uid twice
-        parked_uids = {s.uid for s in self._parked}
+        _, rep = self._find(name)
+        dead = rep.scheduler
+        # a snapshot already detached (parked for retry, or waiting in
+        # the suspect queue) still names this replica as its last home —
+        # its own retry path owns it; counting it here too would run the
+        # same uid twice AND pollute this death's blame set (a queued
+        # suspect was NOT in flight, so it must not break singleton
+        # conviction of the one that was)
+        waiting = {s.uid for s in self._parked} | set(self._suspect_queue)
         lost = [fr for fr in self._requests.values()
                 if not fr.done and fr.replica == name
-                and fr.uid not in parked_uids]
-        dead = rep.scheduler
-        router.replace_replica(name, (factory or self.factory)(name))
+                and fr.uid not in waiting]
+        inflight = {fr.uid for fr in lost}
+        blame_set = (set(blame_uids) & inflight
+                     if blame_uids is not None else set())
+        if not blame_set:
+            blame_set = inflight
+        if blame_set:
+            self.blame.record_death(blame_set, replica=name, reason=reason)
+        # whatever probe ran here has resolved (by dying) — a probe's
+        # death is the strongest conviction evidence
+        probe_uid = self._probe.pop(name, None)
+        rep.isolating = False
+        probed = probe_uid is not None and blame_set == {probe_uid}
+        # conviction judges the (possibly watchdog-narrowed) blame set;
+        # the partition below judges each lost request by its GLOBAL
+        # suspect standing — blame_set may be narrower than the lost
+        # set, and a queued suspect must not slip back into traffic
+        convicted = (self.blame.convict(blame_set, probed=probed)
+                     if blame_set else None)
         # terminalize the dead scheduler's stranded Request objects: they
         # continue as NEW objects, and anything still holding the old
-        # ones (router tenant-quota views) must see them as gone
+        # ones (router tenant-quota views) must see them as gone.  Then
+        # EMPTY the dead scheduler's containers — it may stick around as
+        # a broken replica's placeholder (failed respawn), and a later
+        # shutdown/downsize on it must find nothing to re-detach
         for req in [*dead._queued, *list(dead._running.values()),
                     *dead._preempted]:
             req.finish_reason = "replica_killed"
             req.transition(RequestState.HANDED_OFF)
-        replayed = 0
+        dead._queued.clear()
+        dead._running.clear()
+        dead._preempted.clear()
+        dead._live_uids.clear()
+        dead._parked_backlog = 0
+        # breaker accounting: deaths the blame tracker cannot attribute
+        # to a request, landing soon after a respawn, indict the replica
+        now = time.monotonic()
+        respawned = self._respawned_at.get(name)
+        suspect_death = convicted is not None or any(
+            self.blame.is_suspect(u) for u in blame_set)
+        if respawned is not None and rep.breaker is not None:
+            if now - respawned >= self.startup_window_s:
+                rep.breaker.record_success()   # ran healthy for a while
+            elif not suspect_death:
+                if rep.breaker.record_failure():
+                    self.metrics.record_breaker_open(name)
+                    logger.error(
+                        f"fleet: replica {name} breaker OPEN — repeated "
+                        f"deaths {now - respawned:.2f}s into the "
+                        f"{self.startup_window_s}s startup window")
+        respawned_ok = self._respawn(name, factory=factory)
+        # partition the lost set BEFORE replaying anything: suspects are
+        # reserved for isolation, so innocents must not be placed onto
+        # the replica that is about to probe one
+        innocents: List[FleetRequest] = []
         for fr in lost:
-            self._replay(fr)
-            replayed += 1
-        self.metrics.record_restart(name, replayed)
-        logger.warning(f"fleet: replica {name} killed — respawned, "
-                       f"{replayed} in-flight request(s) replayed")
+            if convicted is not None and fr.uid == convicted:
+                self._quarantine(fr)
+            elif self.blame.is_suspect(fr.uid):
+                # is_suspect, NOT membership in this death's (possibly
+                # watchdog-narrowed) blame set: a known suspect that was
+                # queued-but-unpacked here must still go to isolation,
+                # never back into mixed traffic
+                if fr.uid not in self._suspect_queue:
+                    self._suspect_queue.append(fr.uid)
+            else:
+                innocents.append(fr)
+        if self._suspect_queue and not rep.broken:
+            rep.isolating = True      # reserved: router places elsewhere
+        replayed = 0
+        for fr in innocents:
+            if self._replay(fr):
+                replayed += 1
+        if respawned_ok:
+            self.metrics.record_restart(name, replayed)
+        else:
+            # the death happened and the replays are real, but no
+            # replica restarted — fleet/restarts must not claim one
+            self.metrics.replays += replayed
+        self.metrics.record_death(reason)
+        logger.warning(
+            f"fleet: replica {name} death ({reason}) — "
+            f"respawned={not rep.broken}, {replayed} replayed, "
+            f"suspects={self._suspect_queue}, "
+            f"quarantined={convicted if convicted is not None else 'none'}")
+        self._pump_probes()
         return replayed
 
-    def _replay(self, fr: FleetRequest) -> None:
-        """Continue ``fr`` from the journal on a live replica.  In
-        disaggregated mode the replay re-enters through the prefill pool
-        (its KV died with the replica) and hands off again."""
+    def _respawn(self, name: str,
+                 factory: Optional[SchedulerFactory] = None) -> bool:
+        """Budget- and breaker-gated respawn.  Returns False (and marks
+        the replica ``broken``) when the breaker is open, the fleet
+        restart budget is exhausted, or the factory fails (``spawn_fail``
+        chaos fires here)."""
+        router, rep = self._find(name)
+        if rep.breaker is not None and not rep.breaker.allows():
+            rep.broken = True
+            return False
+        if self.restart_budget is not None \
+                and self.restart_budget.exhausted():
+            logger.error(
+                f"fleet: restart budget exhausted "
+                f"({self.restart_budget.in_window()}/"
+                f"{self.restart_budget.max_restarts} in window) — replica "
+                f"{name} stays down until the window slides")
+            if rep.breaker is not None and rep.breaker.trip():
+                self.metrics.record_breaker_open(name)
+            rep.broken = True
+            return False
+        try:
+            if chaos.fire("spawn_fail"):
+                raise ChaosInjectedError("chaos: spawn_fail armed")
+            sched = (factory or self.factory)(name)
+        except Exception as e:  # noqa: BLE001 — a failed respawn must
+            # degrade capacity, never propagate out of the fleet tick
+            opened = (rep.breaker.record_failure()
+                      if rep.breaker is not None else False)
+            rep.broken = True
+            if opened:
+                self.metrics.record_breaker_open(name)
+            logger.error(
+                f"fleet: respawn of replica {name} FAILED ({e!r}) — "
+                f"breaker "
+                f"{rep.breaker.state.value if rep.breaker else 'none'}, "
+                f"failures "
+                f"{rep.breaker.failures if rep.breaker else 0}")
+            return False
+        router.replace_replica(name, sched)
+        rep.broken = False
+        if self.restart_budget is not None:
+            self.restart_budget.record()
+        self._respawned_at[name] = time.monotonic()
+        return True
+
+    def _probe_broken(self) -> None:
+        """Half-open breaker probes: retry the respawn of broken replicas
+        whose breaker cooloff has elapsed.  A success puts the replica
+        back in placement (breaker closes for good once it survives the
+        startup window); a failure re-opens with a longer cooloff."""
+        for _, rep in list(self.pool_members()):
+            if rep.broken and (rep.breaker is None
+                               or rep.breaker.allows()):
+                if self._respawn(rep.name):
+                    logger.info(f"fleet: breaker probe respawned replica "
+                                f"{rep.name}")
+
+    def _close_recovered_breakers(self) -> None:
+        """A replica that survived ``startup_window_s`` past its last
+        respawn has proven itself: clear its breaker history."""
+        now = time.monotonic()
+        for _, rep in self.pool_members():
+            if rep.broken or rep.breaker is None \
+                    or rep.breaker.failures == 0:
+                continue
+            t = self._respawned_at.get(rep.name)
+            if t is not None and now - t >= self.startup_window_s:
+                # a close is only a close if the breaker had OPENED —
+                # clearing sub-threshold failures is not one (else
+                # breaker_closes could exceed breaker_opens)
+                was_open = rep.breaker.state is not BreakerState.CLOSED
+                rep.breaker.record_success()
+                if was_open:
+                    self.metrics.record_breaker_close(rep.name)
+
+    # -- poison-suspect isolation probes -------------------------------- #
+    def _pump_probes(self) -> None:
+        """Dispatch the next queued suspect onto a reserved (isolating)
+        replica — exactly one probe runs fleet-wide at a time, so a
+        death during the probe has a singleton in-flight set and
+        convicts.  Innocent traffic routes around the probing replica;
+        in a one-replica fleet it parks until the probe resolves."""
+        while self._suspect_queue and not self._probe:
+            uid = self._suspect_queue[0]
+            fr = self._requests.get(uid)
+            if fr is None or fr.done:
+                self._suspect_queue.pop(0)
+                continue
+            rep = self._isolation_replica()
+            if rep is None:
+                return                       # retry next tick
+            self._suspect_queue.pop(0)
+            snap = fr.snapshot()
+            router = self._find(rep.name)[0]
+            try:
+                # pinned THROUGH the router: the probe bypasses scoring
+                # and availability, but not tenant-quota/telemetry
+                router.resubmit(snap, on_token=self._hook(fr),
+                                pin=rep.name)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    f"fleet: isolation probe of request {uid} could not "
+                    f"start on {rep.name} ({e}) — requeued")
+                rep.isolating = False
+                self._suspect_queue.insert(0, uid)
+                return
+            fr.replays += 1
+            fr.replicas.append(rep.name)
+            self._probe[rep.name] = uid
+            self.metrics.record_probe()
+            logger.warning(f"fleet: probing suspect request {uid} in "
+                           f"isolation on replica {rep.name}")
+        if not self._suspect_queue:
+            # release any reservation left over after the queue drained
+            for _, rep in self.pool_members():
+                if rep.isolating and rep.name not in self._probe:
+                    rep.isolating = False
+
+    def _isolation_replica(self) -> Optional[Replica]:
+        """The replica to probe on, or None to retry next tick.  A
+        reserved replica (set at death time, usually the freshly
+        respawned one) is used once DRAINED; with none reserved, the
+        least-pending available replica is reserved NOW — new traffic
+        routes around it, it drains, and the probe dispatches — so a
+        queued suspect makes progress even under sustained traffic
+        where no replica ever reads idle on its own."""
+        for _, rep in self.pool_members():
+            if rep.isolating and rep.name not in self._probe \
+                    and not rep.broken:
+                if rep.scheduler.num_pending == 0:
+                    return rep
+                return None            # reserved, still draining — wait
+        cands = [rep for _, rep in self.pool_members() if rep.available]
+        if not cands:
+            return None
+        rep = min(cands, key=lambda r: r.scheduler.num_pending)
+        rep.isolating = True
+        return rep if rep.scheduler.num_pending == 0 else None
+
+    def _release_probes(self) -> None:
+        """A probe request that finished (or migrated off the probing
+        replica) resolves its probe: a clean finish absolves the suspect
+        — the co-occurrences were bad luck, not causation."""
+        for name, uid in list(self._probe.items()):
+            fr = self._requests.get(uid)
+            if fr is not None and not fr.done and fr.replica == name:
+                continue                     # still running in isolation
+            del self._probe[name]
+            try:
+                _, rep = self._find(name)
+                rep.isolating = False
+            except ValueError:
+                pass                         # replica elastically removed
+            if fr is not None and fr.state == "finished":
+                # terminal AND proven innocent: forget (not absolve —
+                # a terminal uid must leave the score table entirely)
+                self.blame.forget(uid)
+                logger.warning(
+                    f"fleet: suspect request {uid} finished cleanly in "
+                    f"isolation on {name} — absolved")
+
+    # -- terminal bookkeeping ------------------------------------------- #
+    def _terminalize(self, fr: FleetRequest, reason: str,
+                     error: Optional[str] = None) -> None:
+        """Fail a FleetRequest at the FLEET level (it is live in no
+        scheduler — its last incarnation died with its replica)."""
+        if fr.done:
+            return
+        fr.state = "failed"
+        fr.finish_reason = reason
+        fr.error = error
+        fr.finish_time = time.monotonic()
+        self._n_live -= 1
+        self._collected.add(fr.uid)
+        self._finished_order.append(fr.uid)
+
+    def _quarantine(self, fr: FleetRequest) -> None:
+        msg = self.blame.verdict(fr.uid)
+        self._terminalize(fr, "quarantined", error=msg)
+        self.blame.forget(fr.uid)
+        if fr.uid in self._suspect_queue:
+            self._suspect_queue.remove(fr.uid)
+        self.metrics.record_quarantine()
+        logger.error(f"fleet: {msg}")
+
+    def _replay(self, fr: FleetRequest) -> bool:
+        """Continue ``fr`` from the journal on a live replica — unless it
+        has exhausted ``max_replays``, in which case it fails terminally
+        (``reason="replay_budget"``): even a request the blame tracker
+        never convicts cannot replay unboundedly.  In disaggregated mode
+        the replay re-enters through the prefill pool (its KV died with
+        the replica) and hands off again."""
+        if fr.replays >= self.max_replays:
+            self._terminalize(
+                fr, "replay_budget",
+                error=(f"request {fr.uid} exceeded max_replays="
+                       f"{self.max_replays} crash replays"))
+            self.blame.forget(fr.uid)
+            self.metrics.record_replay_budget()
+            logger.error(f"fleet: request {fr.uid} failed — replay "
+                         f"budget ({self.max_replays}) exhausted")
+            return False
         fr.replays += 1
         self._place(fr.snapshot())
+        return True
 
     # ------------------------------------------------------------------ #
     # Rolling drain-then-restart upgrades
@@ -462,6 +905,8 @@ class ServingFleet:
         closed.  Returns ``{replica: requests handed off}``."""
         handed: Dict[str, int] = {}
         for pool, rep in list(self.pool_members()):
+            if rep.broken:
+                continue   # already down — the breaker probe path owns it
             router = self.decode_router if pool == "decode" else self.router
             _, snaps = rep.scheduler.shutdown(drain_deadline_s,
                                               handoff=True)
@@ -470,8 +915,18 @@ class ServingFleet:
             self._collect()
             router.replace_replica(rep.name,
                                    (factory or self.factory)(rep.name))
+            # a planned upgrade is still a respawn: a crash right after
+            # it counts against the breaker's startup window (bad new
+            # binary/config reads exactly like a sick host)
+            self._respawned_at[rep.name] = time.monotonic()
             for snap in snaps:
                 fr = self._requests.get(snap.uid)
+                if self.blame.is_suspect(snap.uid):
+                    # never migrate a poison suspect into innocent
+                    # traffic — it waits for its isolation probe
+                    if snap.uid not in self._suspect_queue:
+                        self._suspect_queue.append(snap.uid)
+                    continue
                 # recompute handoff: host-side queue insertion only — no
                 # latency sample (the KV-carrying pump times its own);
                 # _place parks on failure, so a full survivor set delays
@@ -518,15 +973,29 @@ class ServingFleet:
             raise ValueError("set_replica_count: target must be >= 1")
         while len(router.replicas) < target:
             name = self._next_name(prefix)
-            router.add_replica(name, self.factory(name))
+            rep = router.add_replica(name, self.factory(name))
+            self._install_defenses(rep)
             self.metrics.record_scale(+1)
         while len(router.replicas) > max(target, 1):
-            victim = min(router.replicas, key=lambda r: r.load_tokens())
+            # broken replicas are dead capacity holding no work: always
+            # the cheapest downsize victims (their stranded requests
+            # were terminalized and replayed at death)
+            broken = [r for r in router.replicas if r.broken]
+            victim = (broken[0] if broken else
+                      min(router.replicas, key=lambda r: r.load_tokens()))
             _, snaps = victim.scheduler.shutdown(0.0, handoff=True)
             self._collect()            # finishes already on the victim
             router.remove_replica(victim.name)
+            self._respawned_at.pop(victim.name, None)
+            if victim.name in self._probe:
+                # the probe loses its replica: back to the queue
+                self._suspect_queue.insert(0, self._probe.pop(victim.name))
             for snap in snaps:
                 fr = self._requests.get(snap.uid)
+                if self.blame.is_suspect(snap.uid):
+                    if snap.uid not in self._suspect_queue:
+                        self._suspect_queue.append(snap.uid)
+                    continue
                 if fr is not None:
                     fr.handoffs += 1
                 self.metrics.record_handoff()

@@ -35,6 +35,15 @@ class FleetMetrics:
         self.scale_ups = 0
         self.scale_downs = 0
         self.rolling_restarts = 0    # completed upgrade waves
+        # -- defense in depth ------------------------------------------- #
+        self.quarantined = 0         # poison requests convicted+terminal
+        self.replay_budget_failed = 0  # requests out of crash replays
+        self.isolation_probes = 0    # suspects replayed in isolation
+        self.breaker_opens = 0       # circuit-breaker open transitions
+        self.breaker_closes = 0      # recoveries (survived startup window)
+        self.shed_total = 0          # overload backpressure sheds
+        self.shed_by_class: Dict[str, int] = {}
+        self.deaths_by_reason: Dict[str, int] = {}
         #: bounded: a long-running fleet must not grow host memory per
         #: handoff — percentiles are over the most recent window
         self.handoff_latency_s: Deque[float] = deque(maxlen=1024)
@@ -60,6 +69,37 @@ class FleetMetrics:
     def record_rolling_restart(self) -> None:
         self.rolling_restarts += 1
 
+    # -- defense-in-depth hooks ----------------------------------------- #
+    def record_quarantine(self) -> None:
+        self.quarantined += 1
+
+    def record_replay_budget(self) -> None:
+        self.replay_budget_failed += 1
+
+    def record_probe(self) -> None:
+        """A suspect replayed in isolation — it is a replay too (the
+        request is still alive and being continued)."""
+        self.isolation_probes += 1
+        self.replays += 1
+
+    def record_breaker_open(self, replica: str) -> None:
+        self.breaker_opens += 1
+
+    def record_breaker_close(self, replica: str) -> None:
+        self.breaker_closes += 1
+
+    def record_shed(self, priority_class: str) -> None:
+        self.shed_total += 1
+        self.shed_by_class[priority_class] = \
+            self.shed_by_class.get(priority_class, 0) + 1
+
+    def record_death(self, reason: str) -> None:
+        """One replica incarnation death, by cause (``killed`` | ``crash``
+        | ``tick_stall`` | ...) — slow-but-returning ticks (the watchdog's
+        case) stay distinguishable from hard crashes."""
+        self.deaths_by_reason[reason] = \
+            self.deaths_by_reason.get(reason, 0) + 1
+
     # ------------------------------------------------------------------ #
     # Aggregation
     # ------------------------------------------------------------------ #
@@ -75,7 +115,17 @@ class FleetMetrics:
             "fleet/scale_ups": float(self.scale_ups),
             "fleet/scale_downs": float(self.scale_downs),
             "fleet/rolling_restarts": float(self.rolling_restarts),
+            "fleet/quarantined": float(self.quarantined),
+            "fleet/replay_budget_failed": float(self.replay_budget_failed),
+            "fleet/isolation_probes": float(self.isolation_probes),
+            "fleet/breaker_opens": float(self.breaker_opens),
+            "fleet/breaker_closes": float(self.breaker_closes),
+            "fleet/shed_total": float(self.shed_total),
         }
+        for cls, n in self.shed_by_class.items():
+            out[f"fleet/shed_{cls}"] = float(n)
+        for reason, n in self.deaths_by_reason.items():
+            out[f"fleet/deaths_{reason}"] = float(n)
         if self.handoff_latency_s:
             lat = np.asarray(list(self.handoff_latency_s), np.float64)
             out["fleet/p50_handoff_s"] = float(np.percentile(lat, 50))
@@ -97,6 +147,16 @@ class FleetMetrics:
             pools.setdefault(name, []).append(rep)
         out["fleet/replicas"] = float(
             sum(len(v) for v in pools.values()))
+        members = [rep for reps in pools.values() for rep in reps]
+        out["fleet/replicas_broken"] = float(
+            sum(1 for rep in members if getattr(rep, "broken", False)))
+        out["fleet/breakers_open"] = float(sum(
+            1 for rep in members
+            if getattr(rep, "breaker", None) is not None
+            and not rep.breaker.allows()))
+        out["fleet/suspects_pending"] = float(
+            len(getattr(fleet, "_suspect_queue", ()))
+            + len(getattr(fleet, "_probe", ())))
         goodput = 0.0
         agg = {"submitted": 0.0, "finished": 0.0, "failed": 0.0,
                "preemptions": 0.0, "total_tokens": 0.0}

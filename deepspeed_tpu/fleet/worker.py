@@ -24,7 +24,15 @@ SIGKILLed, OOM-killed, or wedged and the system provably recovers:
   replica's in-flight requests from the journal: the replay snapshot
   carries the delivered tokens as its ``generated`` prefix, so the
   ``(seed, uid, position)``-keyed sampler continues the exact stream.
-  A killed replica loses ZERO requests.
+  A killed replica loses ZERO requests — and a request that KEEPS
+  killing replicas is not replayed forever: every worker death journals
+  its in-flight set into a
+  :class:`~deepspeed_tpu.fleet.defense.CrashBlame` tracker, repeat
+  co-occurrers are replayed **alone** on the respawned worker
+  (isolation — no new traffic routes there), and a conviction
+  terminalizes the request ``failed reason="quarantined"`` with a
+  tenant-visible error.  ``max_replays`` bounds even unconvicted
+  replays (``reason="replay_budget"``).
 
 The IPC is deliberately files-only (atomic-rename inbox, append-only
 event journal, mtime heartbeats) — the same crash-survivable primitives
@@ -40,6 +48,7 @@ import os
 import time
 from typing import Callable, Dict, List, Optional
 
+from deepspeed_tpu.fleet.defense import CrashBlame
 from deepspeed_tpu.fleet.fleet import FleetRequest
 from deepspeed_tpu.resilience import heartbeat as hb
 from deepspeed_tpu.resilience.supervisor import (BackoffPolicy,
@@ -148,7 +157,9 @@ class FleetFrontEnd:
                  restart_window_s: float = 300.0,
                  backoff: Optional[BackoffPolicy] = None,
                  env: Optional[Dict[str, str]] = None,
-                 keep_finished: Optional[int] = None):
+                 keep_finished: Optional[int] = None,
+                 max_replays: int = 5,
+                 blame: Optional[CrashBlame] = None):
         if n_replicas < 1:
             raise ValueError("FleetFrontEnd needs at least one replica")
         self.run_dir = run_dir
@@ -159,13 +170,33 @@ class FleetFrontEnd:
         #: O(1) load/pending reads — submit/poll must not scan the
         #: lifetime journal (same fix ServingFleet carries)
         self._outstanding_by: Dict[str, int] = {}
+        #: uid -> worker currently charged with it, the AUTHORITATIVE
+        #: source for the outstanding counters: ``fr.replica`` is a
+        #: display trail and goes stale for queued suspects / parked
+        #: requests (double-decrement hazard)
+        self._home: Dict[int, str] = {}
         self._n_live = 0
         #: None keeps every FleetRequest; an int bounds journal memory on
         #: long-running front-ends by pruning the oldest finished entries
         self.keep_finished = keep_finished
         self._finished_order: List[int] = []
         self.replays = 0
+        if max_replays < 1:
+            raise ValueError("max_replays must be >= 1")
+        #: per-request crash/reject replay cap -> reason="replay_budget"
+        self.max_replays = max_replays
+        #: poison-request blame/quarantine (see fleet.defense)
+        self.blame = blame if blame is not None else CrashBlame()
+        self.quarantined = 0
+        self.replay_budget_failed = 0
+        #: replica -> uid probed in isolation there (no other routing)
+        self._isolating: Dict[str, int] = {}
+        #: suspect uids awaiting an isolation probe
+        self._suspect_queue: List[int] = []
         self.restarts_seen: Dict[str, int] = {}
+        #: uids with no routable replica right now (e.g. every replica is
+        #: isolating a suspect) — retried every poll, never dropped
+        self._parked: List[int] = []
         #: byte offsets into event journals, keyed (replica, incarnation)
         self._offsets: Dict[tuple, int] = {}
         self.spools: Dict[str, str] = {}
@@ -203,19 +234,18 @@ class FleetFrontEnd:
         return self._outstanding_by.get(name, 0)
 
     def _move(self, fr: FleetRequest, target: Optional[str]) -> None:
-        """Re-home ``fr``'s outstanding count (``target=None`` = done)."""
-        if fr.replica is not None:
-            self._outstanding_by[fr.replica] = max(
-                self._outstanding_by.get(fr.replica, 0) - 1, 0)
+        """Re-home ``fr``'s outstanding count (``target=None`` = detached
+        or done).  Keyed by the ``_home`` map, not ``fr.replica``, so a
+        request already detached (suspect queue, parked) costs nothing
+        a second time."""
+        cur = self._home.pop(fr.uid, None)
+        if cur is not None:
+            self._outstanding_by[cur] = max(
+                self._outstanding_by.get(cur, 0) - 1, 0)
         if target is not None:
             self._outstanding_by[target] = \
                 self._outstanding_by.get(target, 0) + 1
-
-    def _pick_replica(self) -> str:
-        names = list(self.spools)
-        rr = next(self._rr)
-        return min(names, key=lambda n: (
-            self._outstanding(n), (names.index(n) - rr) % len(names)))
+            self._home[fr.uid] = target
 
     def _write_snapshot(self, name: str, snap: RequestSnapshot) -> None:
         inbox = os.path.join(self.spools[name], INBOX_DIR)
@@ -224,19 +254,65 @@ class FleetFrontEnd:
             f.write(snap.to_json())
         os.replace(tmp, os.path.join(inbox, f"{snap.uid}.json"))
 
+    def _dispatch(self, fr: FleetRequest) -> None:
+        """Route ``fr`` to the least-outstanding replica that is NOT
+        isolating a poison suspect; with none routable (every replica
+        probing), park it — retried each poll, never dropped."""
+        names = [n for n in self.spools if n not in self._isolating]
+        if not names:
+            # detach the outstanding charge BEFORE parking: a stale
+            # count on a reserved worker would gate _pump_isolation's
+            # drained check forever (1-worker deadlock)
+            self._move(fr, None)
+            if fr.uid not in self._parked:
+                self._parked.append(fr.uid)
+            return
+        rr = next(self._rr)
+        target = min(names, key=lambda n: (
+            self._outstanding(n), (names.index(n) - rr) % len(names)))
+        self._move(fr, target)
+        fr.replicas.append(target)
+        self._write_snapshot(target, fr.snapshot())
+
     def submit(self, prompt, sampling: Optional[SamplingParams] = None,
                tenant: str = "default") -> FleetRequest:
         uid = next(self._uid_counter)
         fr = FleetRequest(uid=uid, prompt=[int(t) for t in prompt],
                           sampling=sampling or SamplingParams(),
                           tenant=tenant)
-        name = self._pick_replica()
-        self._move(fr, name)
-        fr.replicas.append(name)
         self.requests[uid] = fr
         self._n_live += 1
-        self._write_snapshot(name, fr.snapshot())
+        self._dispatch(fr)
         return fr
+
+    # -- terminal bookkeeping ------------------------------------------- #
+    def _prune_finished(self) -> None:
+        if self.keep_finished is not None:
+            while len(self._finished_order) > self.keep_finished:
+                self.requests.pop(self._finished_order.pop(0), None)
+
+    def _terminalize(self, fr: FleetRequest, reason: str,
+                     error: Optional[str] = None) -> None:
+        """Fail a request at the FRONT-END level (no worker owns it)."""
+        if fr.done:
+            return
+        fr.state = "failed"
+        fr.finish_reason = reason
+        fr.error = error
+        fr.finish_time = time.monotonic()
+        self._move(fr, None)
+        self._n_live -= 1
+        self._finished_order.append(fr.uid)
+        self._prune_finished()
+
+    def _quarantine(self, fr: FleetRequest) -> None:
+        msg = self.blame.verdict(fr.uid, host_kind="worker")
+        self._terminalize(fr, "quarantined", error=msg)
+        self.blame.forget(fr.uid)
+        if fr.uid in self._suspect_queue:
+            self._suspect_queue.remove(fr.uid)
+        self.quarantined += 1
+        logger.error(f"fleet front-end: {msg}")
 
     # -- event ingestion ------------------------------------------------ #
     def _drain_events(self, name: str, attempt: Optional[int] = None,
@@ -282,17 +358,25 @@ class FleetFrontEnd:
                 if fr.on_token is not None:
                     fr.on_token(fr, int(rec["tok"]))
             elif "done" in rec:
-                if rec["done"] == "rejected" and fr.replays < 5:
+                if rec["done"] == "rejected" \
+                        and fr.replays < self.max_replays:
                     # admission rejection (queue burst, draining worker):
                     # bounce to another replica instead of failing — a
                     # bounded number of times, so a truly unservable
-                    # request still terminates
+                    # request still terminates.  A rejected ISOLATION
+                    # PROBE releases its reservation and goes back to
+                    # the suspect queue — never into mixed traffic
+                    for iso_name, puid in list(self._isolating.items()):
+                        if puid == fr.uid:
+                            del self._isolating[iso_name]
+                    if self.blame.is_suspect(fr.uid):
+                        if fr.uid not in self._suspect_queue:
+                            self._suspect_queue.append(fr.uid)
+                        self._move(fr, None)
+                        continue
                     fr.replays += 1
                     self.replays += 1
-                    target = self._pick_replica()
-                    self._move(fr, target)
-                    fr.replicas.append(target)
-                    self._write_snapshot(target, fr.snapshot())
+                    self._dispatch(fr)
                     continue
                 fr.state = ("finished" if rec.get("state") == "finished"
                             else "failed")
@@ -301,12 +385,21 @@ class FleetFrontEnd:
                 self._move(fr, None)
                 self._n_live -= 1
                 self._finished_order.append(fr.uid)
-                if self.keep_finished is not None:
-                    while len(self._finished_order) > self.keep_finished:
-                        self.requests.pop(self._finished_order.pop(0),
-                                          None)
+                self._prune_finished()
+                # terminal: the blame score table tracks LIVE uids only
+                self.blame.forget(fr.uid)
+                # probe resolution: the suspect finished in isolation —
+                # a clean finish absolves (bad luck, not causation)
+                for iso_name, puid in list(self._isolating.items()):
+                    if puid == fr.uid:
+                        del self._isolating[iso_name]
+                        if fr.state == "finished":
+                            logger.warning(
+                                f"fleet front-end: suspect {puid} "
+                                f"finished cleanly in isolation on "
+                                f"{iso_name} — absolved")
 
-    # -- supervision + replay ------------------------------------------- #
+    # -- supervision + blame + replay ----------------------------------- #
     def _check_restarts(self) -> None:
         for name, sup in self.supervisors.items():
             if sup.returncode is not None and sup.returncode != 0:
@@ -327,19 +420,100 @@ class FleetFrontEnd:
                         os.remove(os.path.join(inbox, stale))
                     except OSError:
                         pass
+                # whatever probe ran here resolved — by killing its
+                # host, the strongest conviction evidence
+                probe_uid = self._isolating.pop(name, None)
+                # parked/queued requests are not ON this worker: their
+                # own retry paths continue them; replaying here too would
+                # run the same uid twice
+                waiting = set(self._parked) | set(self._suspect_queue)
                 lost = [fr for fr in self.requests.values()
-                        if not fr.done and fr.replica == name]
+                        if not fr.done and fr.replica == name
+                        and fr.uid not in waiting]
+                # journal the incarnation death's exact in-flight set
+                blame_set = {fr.uid for fr in lost}
+                if blame_set:
+                    self.blame.record_death(blame_set, replica=name,
+                                            reason="crash")
+                probed = (probe_uid is not None
+                          and blame_set == {probe_uid})
+                convicted, suspect_uids, _ = \
+                    self.blame.classify_lost(blame_set, probed=probed) \
+                    if blame_set else (None, [], [])
+                if suspect_uids or self._suspect_queue:
+                    # RESERVE the respawned worker for isolation BEFORE
+                    # redispatching innocents — under sustained traffic
+                    # no worker ever reads idle, and an unreserved probe
+                    # would starve in the queue forever
+                    self._isolating.setdefault(name, None)
+                replayed = 0
                 for fr in lost:
-                    fr.replays += 1
-                    self.replays += 1
-                    target = self._pick_replica()
-                    self._move(fr, target)
-                    fr.replicas.append(target)
-                    self._write_snapshot(target, fr.snapshot())
+                    if convicted is not None and fr.uid == convicted:
+                        self._quarantine(fr)
+                    elif fr.uid in suspect_uids:
+                        # suspects never re-enter mixed traffic: they
+                        # wait for an isolation probe on an idle worker
+                        if fr.uid not in self._suspect_queue:
+                            self._suspect_queue.append(fr.uid)
+                        self._move(fr, None)
+                    elif fr.replays >= self.max_replays:
+                        self._terminalize(
+                            fr, "replay_budget",
+                            error=(f"request {fr.uid} exceeded "
+                                   f"max_replays={self.max_replays} "
+                                   f"crash replays"))
+                        self.blame.forget(fr.uid)
+                        self.replay_budget_failed += 1
+                    else:
+                        fr.replays += 1
+                        self.replays += 1
+                        self._dispatch(fr)
+                        replayed += 1
                 logger.warning(
                     f"fleet front-end: replica {name} restarted "
-                    f"(attempt {sup.attempt}) — replayed {len(lost)} "
-                    f"in-flight request(s)")
+                    f"(attempt {sup.attempt}) — {replayed} replayed, "
+                    f"suspects={self._suspect_queue}, "
+                    f"quarantined="
+                    f"{convicted if convicted is not None else 'none'}")
+        self._pump_isolation()
+
+    def _pump_isolation(self) -> None:
+        """Dispatch queued suspects, each ALONE onto a worker with
+        nothing outstanding (the respawned one qualifies: its in-flight
+        set was just replayed away).  ``_dispatch`` routes innocent
+        traffic around isolating workers, so the next death there has a
+        singleton in-flight set — and convicts."""
+        while self._suspect_queue:
+            # reserved workers (value None: set aside at death time,
+            # before innocents could be redispatched there) first, then
+            # any fully idle unreserved worker
+            cands = [n for n, v in self._isolating.items()
+                     if v is None and self._outstanding(n) == 0]
+            cands += [n for n in self.spools
+                      if n not in self._isolating
+                      and self._outstanding(n) == 0]
+            if not cands:
+                return                      # retry next poll
+            uid = self._suspect_queue[0]
+            fr = self.requests.get(uid)
+            if fr is None or fr.done:
+                self._suspect_queue.pop(0)
+                continue
+            self._suspect_queue.pop(0)
+            name = cands[0]
+            self._isolating[name] = uid
+            fr.replays += 1
+            self.replays += 1
+            self._move(fr, name)
+            fr.replicas.append(name)
+            self._write_snapshot(name, fr.snapshot())
+            logger.warning(f"fleet front-end: probing suspect request "
+                           f"{uid} in isolation on {name}")
+        # queue drained: release any leftover reservations so the
+        # workers rejoin normal dispatch
+        for n, v in list(self._isolating.items()):
+            if v is None:
+                del self._isolating[n]
 
     # -- driving -------------------------------------------------------- #
     @property
@@ -350,6 +524,12 @@ class FleetFrontEnd:
         for name in self.spools:
             self._drain_events(name)
         self._check_restarts()
+        if self._parked:
+            parked, self._parked = self._parked, []
+            for uid in parked:
+                fr = self.requests.get(uid)
+                if fr is not None and not fr.done:
+                    self._dispatch(fr)      # may re-park
 
     def run_until_idle(self, timeout_s: float = 120.0,
                        poll_s: float = 0.02) -> List[FleetRequest]:

@@ -38,6 +38,27 @@ survive; fired per step by :class:`ResilientTrainLoop`, per beat by
     heartbeat thread / stalled NFS mount; the supervisor must treat the
     stale file as a hang).
 
+Fleet defense fault points (the hostile inputs / sick replicas the
+quarantine + circuit-breaker + watchdog layer in
+:mod:`deepspeed_tpu.fleet.defense` exists to survive):
+
+``poison_request``
+    fired by :meth:`ContinuousBatchScheduler.step` once per request
+    packed into the engine forward, with ``key=str(uid)`` — arm it with
+    a matching ``key`` to model a malformed request that
+    deterministically crashes the engine whenever it is batched
+    (default: ``raise`` in-process; use ``crash`` for subprocess
+    workers).
+``tick_stall``
+    inside the scheduler tick, bracketed by the tick-watchdog timer
+    (default: ``sleep`` — a slow-but-returning engine forward the
+    watchdog must flag; arm with ``hang`` to model a true wedge only
+    the supervisor's heartbeat detector can see).
+``spawn_fail``
+    in :meth:`ServingFleet._respawn` before the scheduler factory runs
+    (default: ``raise`` — a replica whose respawn keeps failing must
+    open its circuit breaker instead of eating restart budget).
+
 Actions: ``crash`` (``os._exit``, for subprocess kill tests), ``raise``
 (:class:`ChaosInjectedError`, for in-process tests), ``corrupt`` (flip one
 byte of the file at the fault point's ``path``), ``sleep``, ``hang``
@@ -49,9 +70,12 @@ Arming: :func:`arm` / :func:`disarm` / the :func:`inject` context manager,
 or the ``DS_CHAOS`` environment variable for subprocesses, e.g.::
 
     DS_CHAOS="crash_after_shard_write:after=1,exit_code=43"
+    DS_CHAOS="poison_request:action=crash,key=7,count=0"
 
 ``after=N`` skips the first N hits of the point (fire on hit N+1);
-``count=M`` fires at most M times (default 1).
+``count=M`` fires at most M times (default 1); ``key=K`` restricts the
+fault to ``fire`` calls carrying the same key (non-matching calls are
+not even counted as hits).
 """
 
 from __future__ import annotations
@@ -73,6 +97,9 @@ FAULT_POINTS: Dict[str, str] = {
     "worker_crash": "crash",
     "worker_hang": "hang",
     "heartbeat_stall": "drop",
+    "poison_request": "raise",
+    "tick_stall": "sleep",
+    "spawn_fail": "raise",
 }
 
 ENV_VAR = "DS_CHAOS"
@@ -90,6 +117,9 @@ class Fault:
     count: int = 1          # fire at most ``count`` times (0 = unlimited)
     sleep_s: float = 0.05   # action='sleep'
     exit_code: int = 43     # action='crash'
+    #: restrict the fault to ``fire`` calls carrying this key (e.g. a
+    #: request uid for ``poison_request``); None matches every call
+    key: Optional[str] = None
     hits: int = 0
     fires: int = 0
 
@@ -120,6 +150,10 @@ def disarm(point: Optional[str] = None) -> None:
 
 
 def armed(point: str) -> Optional[Fault]:
+    """The fault armed at ``point`` (or None).  Loads ``DS_CHAOS`` first,
+    so call sites may use this as a cheap gate before per-item ``fire``
+    loops without missing env-armed subprocess faults."""
+    _load_env_once()
     return _armed.get(point)
 
 
@@ -153,7 +187,7 @@ def _load_env_once() -> None:
         opts: Dict[str, object] = {}
         for kv in filter(None, (s.strip() for s in opt_str.split(","))):
             k, _, v = kv.partition("=")
-            if k == "action":
+            if k in ("action", "key"):
                 opts[k] = v
             elif k == "sleep_s":
                 opts[k] = float(v)
@@ -179,13 +213,18 @@ def _flip_byte(path: str) -> None:
         os.fsync(f.fileno())
 
 
-def fire(point: str, path: Optional[str] = None) -> bool:
+def fire(point: str, path: Optional[str] = None,
+         key: Optional[str] = None) -> bool:
     """The fault point itself: a no-op unless ``point`` is armed.
     Returns True when a fault fired (the ``drop`` contract: the call site
-    skips the instrumented operation on True)."""
+    skips the instrumented operation on True).  ``key`` identifies the
+    specific operation at the point (e.g. the request uid being fed); a
+    fault armed with a ``key`` fires only on matching calls."""
     _load_env_once()
     fault = _armed.get(point)
     if fault is None:
+        return False
+    if fault.key is not None and key != fault.key:
         return False
     fault.hits += 1
     if fault.hits <= fault.after:

@@ -32,6 +32,7 @@ class ResilienceMetrics:
         self.restarts = 0
         self.restart_crash = 0
         self.restart_hang = 0
+        self.restart_startup = 0
         self.restart_attempt = 0
         self.last_restart_backoff_s = 0.0
         self.hangs = 0
@@ -69,12 +70,16 @@ class ResilienceMetrics:
     # -- supervision hooks ---------------------------------------------- #
     def record_restart(self, reason: str, attempt: int, backoff_s: float,
                        world_before: int, world_after: int) -> None:
-        """One worker-group restart (reason: "crash" | "hang")."""
+        """One worker-group restart (reason: "crash" | "hang" |
+        "startup" — the worker died/stalled before its FIRST heartbeat:
+        bad binary/config, not steady-state bad luck)."""
         self.restarts += 1
         if reason == "crash":
             self.restart_crash += 1
         elif reason == "hang":
             self.restart_hang += 1
+        elif reason == "startup":
+            self.restart_startup += 1
         self.restart_attempt = int(attempt)
         self.last_restart_backoff_s = float(backoff_s)
         self.world_size = int(world_after)
@@ -108,6 +113,7 @@ class ResilienceMetrics:
             "restart_total": float(self.restarts),
             "restart_crash": float(self.restart_crash),
             "restart_hang": float(self.restart_hang),
+            "restart_startup": float(self.restart_startup),
             "restart_attempt": float(self.restart_attempt),
             "restart_backoff_s": self.last_restart_backoff_s,
             "hangs": float(self.hangs),

@@ -467,7 +467,11 @@ class JobSupervisor:
     def _watch(self) -> Optional[Tuple[str, WorkerHandle,
                                        Optional[int], Optional[float]]]:
         """Block until a fault, clean completion (None), or stop().
-        Returns (reason, culprit, exit_code, heartbeat_age)."""
+        Returns (reason, culprit, exit_code, heartbeat_age).  ``reason``
+        is ``"crash"`` (nonzero exit), ``"hang"`` (beats went stale), or
+        ``"startup"`` — the worker died or stalled before its FIRST
+        beat: bad binary/config territory, which circuit breakers and
+        operators must tell apart from steady-state bad luck."""
         while not self._stop.is_set():
             now = time.monotonic()
             any_alive = False
@@ -475,14 +479,17 @@ class JobSupervisor:
                 rc = h.proc.poll()
                 if rc is not None:
                     if rc != 0:
-                        return ("crash", h, rc, None)
+                        _, beating = h.beat_age(now)
+                        return ("crash" if beating else "startup",
+                                h, rc, None)
                     continue
                 any_alive = True
                 age, beating = h.beat_age(now)
                 limit = (self.hang_timeout_s if beating
                          else self.startup_timeout_s)
                 if age > limit:
-                    return ("hang", h, None, age)
+                    return ("hang" if beating else "startup",
+                            h, None, age)
             if not any_alive:
                 return None
             self._stop.wait(self.poll_s)
@@ -508,14 +515,17 @@ class JobSupervisor:
                 self.metrics.export()
                 return
             reason, culprit, rc, age = fault
-            if reason == "hang":
+            if rc is None:
+                # still alive but silent: steady-state hang, or a worker
+                # that never got through startup — dump its stacks first
                 self._event("hang_detected", host=culprit.host,
-                            pid=culprit.pid, age_s=round(age, 4))
+                            pid=culprit.pid, age_s=round(age, 4),
+                            reason=reason)
                 self.metrics.record_hang(culprit.host, age)
                 self._capture_dump(culprit)
             else:
                 self._event("crash_detected", host=culprit.host,
-                            pid=culprit.pid, rc=rc)
+                            pid=culprit.pid, rc=rc, reason=reason)
             # sibling health must be read BEFORE teardown: after
             # _stop_all every survivor reports a signal exit
             sib_healthy = {h: h.proc.poll() in (None, 0)

@@ -23,10 +23,12 @@ from deepspeed_tpu.serving.router import (AdmissionRejectedError,
                                           TenantQuota)
 from deepspeed_tpu.serving.sampler import sample_batch, sample_one
 from deepspeed_tpu.serving.scheduler import (ContinuousBatchScheduler,
-                                             QueueFullError)
+                                             QueueFullError,
+                                             TickDeadlineError)
 
 __all__ = ["AdmissionRejectedError", "CacheAwareRouter",
            "ContinuousBatchScheduler", "PriorityClass", "QueueFullError",
            "QuotaExceededError", "Replica", "Request", "RequestSnapshot",
            "RequestState", "SamplingParams", "ServingMetrics",
-           "TenantQuota", "sample_batch", "sample_one"]
+           "TenantQuota", "TickDeadlineError", "sample_batch",
+           "sample_one"]

@@ -87,6 +87,15 @@ class Replica:
     def __init__(self, name: str, scheduler: ContinuousBatchScheduler):
         self.name = name
         self.scheduler = scheduler
+        #: defense-in-depth flags, maintained by the owning fleet:
+        #: ``broken`` — the last respawn failed; no live engine behind
+        #: this entry until a circuit-breaker probe succeeds.
+        #: ``isolating`` — a poison-suspect probe is running here; no
+        #: other traffic may co-batch with it.
+        #: ``breaker`` — per-replica CircuitBreaker (None = always on).
+        self.broken = False
+        self.isolating = False
+        self.breaker = None
 
     def prefix_match_tokens(self, tokens: Sequence[int]) -> int:
         """Longest prefix of ``tokens`` warm in this replica's KV cache
@@ -105,6 +114,14 @@ class Replica:
         """False while the replica drains for a rolling restart — the
         router must place traffic elsewhere."""
         return getattr(self.scheduler, "accepting_submissions", True)
+
+    @property
+    def available(self) -> bool:
+        """Placeable: accepting submissions, not broken (failed respawn),
+        not reserved for a poison-suspect isolation probe, and with a
+        closed (or half-open, probing) circuit breaker."""
+        return (self.accepting and not self.broken and not self.isolating
+                and (self.breaker is None or self.breaker.allows()))
 
     @property
     def num_pending(self) -> int:
@@ -179,12 +196,16 @@ class CacheAwareRouter:
         """Accepting replicas in placement-preference order: highest
         cache-minus-load score, ties to the lighter replica, then
         rotating round-robin so equal replicas share cold traffic.
-        Draining replicas (rolling restart) are never candidates."""
-        scored = [s for s in self._score(prompt) if s[3].accepting]
+        Draining replicas (rolling restart), broken replicas (failed
+        respawn), isolation probes, and open circuit breakers are never
+        candidates; the router raises only when EVERY replica is out."""
+        scored = [s for s in self._score(prompt) if s[3].available]
         if not scored:
             raise RuntimeError(
-                "router: no replica is accepting submissions (the whole "
-                "fleet is draining) — retry after the upgrade wave")
+                "router: no replica is available — every replica is "
+                "draining, broken, isolating a poison suspect, or has "
+                "its circuit breaker open; retry after the upgrade wave "
+                "or breaker cooloff")
         rr = next(self._rr)
         n = len(scored)
         order = sorted(
@@ -335,21 +356,36 @@ class CacheAwareRouter:
         return req
 
     def resubmit(self, snap, kv_state=None, on_token=None,
-                 exclude: Sequence[str] = ()) -> Request:
+                 exclude: Sequence[str] = (),
+                 pin: Optional[str] = None) -> Request:
         """Place a handed-off request (a
         :class:`~deepspeed_tpu.serving.request.RequestSnapshot`) on the
         best accepting replica — scored by the FULL history so a replica
         holding the request's own warm prefix wins — and continue it via
         the target scheduler's ``resubmit``.  ``exclude`` names replicas
-        that must not receive it (e.g. the one it just left)."""
-        history = snap.history
-        ranked = [(s, h, l, rep) for s, h, l, rep in self._ranked(history)
-                  if rep.name not in exclude]
-        if not ranked:
-            raise RuntimeError(
-                f"router: no replica can take handed-off request "
-                f"{snap.uid} (excluded: {list(exclude)})")
-        _, hit, _, rep = ranked[0]
+        that must not receive it (e.g. the one it just left).  ``pin``
+        forces placement onto that one replica, bypassing availability
+        (the fleet's poison-suspect isolation probes land on a replica
+        deliberately reserved OUT of normal placement) while keeping the
+        tenant-quota and telemetry accounting every placement path
+        shares."""
+        if pin is not None:
+            rep = next((r for r in self.replicas if r.name == pin), None)
+            if rep is None:
+                raise RuntimeError(
+                    f"router: unknown pinned replica {pin!r} for "
+                    f"request {snap.uid}")
+            hit = 0
+        else:
+            history = snap.history
+            ranked = [(s, h, l, rep)
+                      for s, h, l, rep in self._ranked(history)
+                      if rep.name not in exclude]
+            if not ranked:
+                raise RuntimeError(
+                    f"router: no replica can take handed-off request "
+                    f"{snap.uid} (excluded: {list(exclude)})")
+            _, hit, _, rep = ranked[0]
         req = rep.scheduler.resubmit(snap, kv_state=kv_state,
                                      on_token=on_token)
         req.tenant = snap.tenant

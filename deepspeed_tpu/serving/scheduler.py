@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.resilience import chaos
 from deepspeed_tpu.resilience.heartbeat import Heartbeat
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import (Request, RequestState,
@@ -47,6 +48,24 @@ class QueueFullError(RuntimeError):
     bound under overload."""
 
 
+class TickDeadlineError(RuntimeError):
+    """The tick watchdog tripped: one scheduler tick (engine forward +
+    sample) exceeded ``tick_deadline_s``.  Carries the packed batch's
+    uids so the fleet's crash-blame tracker can attribute the stall to
+    the requests that were actually in the forward — a slow-but-
+    returning tick is *detected here* (the scheduler still beats its
+    heartbeat), while a truly wedged forward never returns and is the
+    supervisor's hang detector's job."""
+
+    def __init__(self, uids, elapsed_s: float, deadline_s: float):
+        super().__init__(
+            f"scheduler tick blew its {deadline_s:.3f}s deadline "
+            f"({elapsed_s:.3f}s) with uids {sorted(uids)} in the batch")
+        self.uids = list(uids)
+        self.elapsed_s = elapsed_s
+        self.deadline_s = deadline_s
+
+
 class ContinuousBatchScheduler:
     """Owns the request lifecycle between user ``submit()`` calls and
     :class:`~deepspeed_tpu.inference.v2.engine_v2.InferenceEngineV2`."""
@@ -55,7 +74,8 @@ class ContinuousBatchScheduler:
                  metrics: Optional[ServingMetrics] = None,
                  export_every: int = 0,
                  max_queue: Optional[int] = None,
-                 fast_decode: bool = True):
+                 fast_decode: bool = True,
+                 tick_deadline_s: Optional[float] = None):
         self.engine = engine
         #: pure-decode ticks go through ``engine.decode_step`` — block
         #: tables/positions stay device-resident across ticks and the
@@ -78,6 +98,14 @@ class ContinuousBatchScheduler:
             raise ValueError("max_queue must be >= 1 (or None for unbounded)")
         #: bounded admission: submit() raises QueueFullError past this
         self.max_queue = max_queue
+        if tick_deadline_s is not None and tick_deadline_s <= 0:
+            raise ValueError("tick_deadline_s must be > 0 (or None)")
+        #: tick watchdog: a tick slower than this raises
+        #: :class:`TickDeadlineError` naming the packed batch, AFTER the
+        #: engine returns (a wedged forward that never returns is the
+        #: supervisor heartbeat detector's case, not this one)
+        self.tick_deadline_s = tick_deadline_s
+        self.tick_deadline_trips = 0
         self._queued: List[Request] = []
         self._running: Dict[int, Request] = {}
         self._preempted: List[Request] = []
@@ -224,6 +252,17 @@ class ContinuousBatchScheduler:
         for req in packed:
             if req.first_scheduled_time is None:
                 req.first_scheduled_time = now
+        if chaos.armed("poison_request") is not None:
+            # a malformed request deterministically crashes the engine
+            # the moment it is batched into a forward — the crash the
+            # fleet's quarantine layer must attribute and contain
+            for req in packed:
+                chaos.fire("poison_request", key=str(req.uid))
+        # monotonic on purpose: this is a liveness DEADLINE (host-side
+        # control flow), not a device-compute timing bracket — a tick
+        # that stalls on anything (engine, allocator, GIL) should trip
+        t0 = time.monotonic()
+        chaos.fire("tick_stall")
         if self.fast_decode and all(r.state is RequestState.DECODE
                                     for r in packed):
             emitted = self._fast_decode_tick(uids, chunks, packed)
@@ -232,6 +271,13 @@ class ContinuousBatchScheduler:
             for req, chunk in zip(packed, chunks):
                 req.fed += len(chunk)
             emitted = self._sample_and_advance(packed, logits)
+        if self.tick_deadline_s is not None:
+            elapsed = time.monotonic() - t0
+            if elapsed > self.tick_deadline_s:
+                self.tick_deadline_trips += 1
+                self._tick += 1
+                raise TickDeadlineError([r.uid for r in packed],
+                                        elapsed, self.tick_deadline_s)
         self._tick += 1
         if self.export_every and self._tick % self.export_every == 0:
             self._export_metrics()

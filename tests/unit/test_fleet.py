@@ -24,16 +24,20 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from deepspeed_tpu.fleet import (FleetAutoscaler, FleetMetrics,
-                                 ServingFleet)
+from deepspeed_tpu.fleet import (AdmissionBudget, BreakerState,
+                                 CircuitBreaker, CrashBlame,
+                                 FleetAutoscaler, FleetMetrics,
+                                 OverloadShedError, ServingFleet)
 from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
                                         RaggedInferenceEngineConfig)
 from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
 from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.resilience import chaos
+from deepspeed_tpu.resilience.supervisor import RestartBudget
 from deepspeed_tpu.serving import (CacheAwareRouter,
                                    ContinuousBatchScheduler, Request,
                                    RequestSnapshot, RequestState,
-                                   SamplingParams)
+                                   SamplingParams, TickDeadlineError)
 
 CFG = LlamaConfig.tiny(dtype=jnp.float32)
 _TOOL = pathlib.Path(__file__).resolve().parents[2] / "tools" / \
@@ -48,7 +52,8 @@ def params():
         jax.random.key(0), np.zeros((1, 4), np.int32))["params"]
 
 
-def _sched(params, num_blocks=17, prefix_cache=False, max_queue=None):
+def _sched(params, num_blocks=17, prefix_cache=False, max_queue=None,
+           tick_deadline_s=None):
     cfg = RaggedInferenceEngineConfig.from_dict({
         "state_manager": {"max_ragged_batch_size": 32,
                           "max_ragged_sequence_count": 4,
@@ -59,7 +64,7 @@ def _sched(params, num_blocks=17, prefix_cache=False, max_queue=None):
     })
     return ContinuousBatchScheduler(
         InferenceEngineV2(RaggedLlama(CFG, 8), params, cfg),
-        max_queue=max_queue)
+        max_queue=max_queue, tick_deadline_s=tick_deadline_s)
 
 
 def _prompts(n=3, seed=0):
@@ -501,6 +506,357 @@ def test_router_add_remove_replace_replicas(params):
 
 
 # --------------------------------------------------------------------- #
+# Defense in depth: crash blame, circuit breakers, admission budget
+# (pure policy units — synthetic traces, injected clocks)
+# --------------------------------------------------------------------- #
+def test_crash_blame_scoring_isolation_and_conviction():
+    b = CrashBlame(suspect_after=2, convict_after=2)
+    b.record_death([1, 2, 3], replica="r0")
+    assert b.suspects() == [] and b.convict([1, 2, 3]) is None
+    b.record_death([1, 4], replica="r1")
+    assert b.is_suspect(1) and not b.is_suspect(2)
+    # co-batched deaths never convict — only a singleton in-flight set
+    assert b.convict([1, 4]) is None
+    # at 2 deaths an UN-probed singleton escalates to a suspect, it does
+    # not convict (two operator kills of a lone request are not proof);
+    # the same evidence from a deliberate isolation probe convicts
+    assert b.convict([1]) is None
+    assert b.convict([1], probed=True) == 1
+    b.record_death([1], replica="r0")
+    assert b.convict([1]) == 1           # 3rd death: convicts un-probed
+    # the shared partition both death paths apply
+    convicted, suspects, innocents = b.classify_lost({1})
+    assert convicted == 1 and suspects == [] and innocents == []
+    convicted, suspects, innocents = b.classify_lost({1, 2})
+    assert convicted is None and suspects == [1] and innocents == [2]
+    # a singleton death of a FIRST-time offender does not convict
+    b2 = CrashBlame()
+    b2.record_death([9])
+    assert b2.convict([9]) is None and b2.convict([9], probed=True) is None
+    # the journal keeps the exact in-flight set per death
+    assert [d["uids"] for d in b.deaths] == [[1, 2, 3], [1, 4], [1]]
+    # absolution clears the score; new evidence reopens the case
+    b.absolve(4)
+    assert not b.is_suspect(4) and b.death_count(4) == 0
+    b.record_death([4, 5])
+    assert b.death_count(4) == 1
+    b.forget(1)
+    assert b.death_count(1) == 0
+
+
+def test_circuit_breaker_open_halfopen_close_cycle():
+    now = [0.0]
+    cb = CircuitBreaker(failure_threshold=2, cooloff_s=10.0,
+                        cooloff_factor=2.0, clock=lambda: now[0])
+    assert cb.state is BreakerState.CLOSED and cb.allows()
+    assert cb.record_failure() is False          # 1/2: still closed
+    assert cb.record_failure() is True           # 2/2: OPEN
+    assert cb.state is BreakerState.OPEN and not cb.allows()
+    now[0] = 9.9
+    assert not cb.allows()
+    now[0] = 10.0                                # cooloff elapsed
+    assert cb.state is BreakerState.HALF_OPEN and cb.allows()
+    assert cb.record_failure() is True           # probe failed: re-OPEN
+    assert cb.cooloff_s == 20.0                  # escalated
+    assert not cb.allows()
+    now[0] = 30.0
+    assert cb.state is BreakerState.HALF_OPEN
+    cb.record_success()                          # probe succeeded
+    assert cb.state is BreakerState.CLOSED and cb.failures == 0
+    assert cb.cooloff_s == 10.0                  # cooloff reset
+    cb.trip()                                    # force-open (budget out)
+    assert not cb.allows() and cb.opens == 3
+
+
+def test_admission_budget_sheds_lowest_class_first():
+    a = AdmissionBudget(max_backlog_tokens=100.0)
+    a.admit(10, "batch", backlog_tokens=0)       # 10 <= 50: fine
+    with pytest.raises(OverloadShedError) as ei:
+        a.admit(10, "batch", backlog_tokens=45)  # 55 > 50: shed
+    assert ei.value.retry_after_s > 0 and ei.value.shed_class == "batch"
+    a.admit(10, "standard", backlog_tokens=45)   # 55 <= 85
+    a.admit(10, "interactive", backlog_tokens=85)  # 95 <= 100
+    with pytest.raises(OverloadShedError):
+        a.admit(10, "interactive", backlog_tokens=95)
+    snap = a.snapshot()
+    assert snap["admitted"] == 3.0 and snap["shed_total"] == 2.0
+    assert snap["shed_batch"] == 1.0 and snap["shed_interactive"] == 1.0
+    # retry-after derives from the measured drain rate when given
+    with pytest.raises(OverloadShedError) as ei:
+        a.admit(20, "batch", backlog_tokens=50, drain_tokens_per_s=10.0)
+    assert ei.value.retry_after_s == pytest.approx(2.0)  # 20 excess / 10
+
+
+def test_admission_budget_rate_gate_class_floors():
+    now = [0.0]
+    a = AdmissionBudget(admit_tokens_per_s=10.0, burst_tokens=100.0,
+                        clock=lambda: now[0])
+    a.admit(40, "batch")                  # level 100 -> 60 (floor 50)
+    with pytest.raises(OverloadShedError) as ei:
+        a.admit(20, "batch")              # would cross batch's 50 floor
+    assert ei.value.retry_after_s == pytest.approx(1.0)  # 10 short @ 10/s
+    a.admit(20, "interactive")            # floor 0: 60 -> 40
+    now[0] = 2.0                          # refill 20 tokens -> 60
+    a.admit(10, "batch")                  # 60 -> 50, at the floor exactly
+    with pytest.raises(OverloadShedError):
+        a.admit(1, "batch")
+    with pytest.raises(ValueError, match="needs"):
+        AdmissionBudget()
+    with pytest.raises(ValueError, match="ceilings"):
+        AdmissionBudget(max_backlog_tokens=10, default_ceiling=1.5)
+
+
+# --------------------------------------------------------------------- #
+# Defense in depth, integrated: poison quarantine, breaker, watchdog,
+# replay budget, overload — all in-process with chaos fault points
+# --------------------------------------------------------------------- #
+def test_poison_request_quarantined_innocents_exact(params, gold):
+    """A request that deterministically crashes the engine whenever it is
+    batched must be convicted via blame+isolation within <= 3 respawns;
+    every innocent (including co-batched ones) finishes greedy-exact."""
+    fleet = ServingFleet(lambda name: _sched(params), replicas=2)
+    samp = SamplingParams(greedy=True, max_new_tokens=GEN)
+    frs = [fleet.submit(p, sampling=samp) for p in _prompts()]
+    poison = fleet.submit(list(range(1, 11)), sampling=samp)
+    chaos.arm("poison_request", "raise", key=str(poison.uid), count=0)
+    try:
+        fleet.run_until_idle(max_ticks=500)
+    finally:
+        chaos.disarm("poison_request")
+    assert poison.state == "failed"
+    assert poison.finish_reason == "quarantined"
+    assert poison.error and "quarantined" in poison.error
+    from deepspeed_tpu.fleet import QuarantinedError
+    with pytest.raises(QuarantinedError, match="quarantined"):
+        poison.check()
+    for i, fr in enumerate(frs):
+        assert fr.state == "finished", (fr.uid, fr.state, fr.finish_reason)
+        assert fr.tokens == gold[i], i
+    snap = fleet.snapshot()
+    assert 1.0 <= snap["fleet/restarts"] <= 3.0
+    assert snap["fleet/quarantined"] == 1.0
+    assert snap["fleet/isolation_probes"] >= 1.0
+    assert snap["fleet/deaths_crash"] == snap["fleet/restarts"]
+    # the journal recorded every death's exact in-flight set
+    assert all(poison.uid in d["uids"] for d in fleet.blame.deaths)
+
+
+def test_poison_quarantined_in_disaggregated_fleet(params, gold):
+    """A poison that crashes only once DECODING (chaos after=1 skips its
+    prefill pack) kills a DECODE replica first; the blame/isolation
+    pipeline must still converge — and a suspect under probe is never
+    pumped off its isolation replica into the decode pool's traffic."""
+    fleet = ServingFleet(lambda name: _sched(params),
+                         prefill_replicas=1, decode_replicas=2)
+    samp = SamplingParams(greedy=True, max_new_tokens=GEN)
+    frs = [fleet.submit(p, sampling=samp) for p in _prompts()]
+    poison = fleet.submit(list(range(1, 11)), sampling=samp)
+    chaos.arm("poison_request", "raise", key=str(poison.uid), count=0,
+              after=1)
+    try:
+        fleet.run_until_idle(max_ticks=800)
+    finally:
+        chaos.disarm("poison_request")
+    assert poison.state == "failed"
+    assert poison.finish_reason == "quarantined"
+    for i, fr in enumerate(frs):
+        assert fr.state == "finished", (fr.uid, fr.state, fr.finish_reason)
+        assert fr.tokens == gold[i], i
+    snap = fleet.snapshot()
+    assert snap["fleet/quarantined"] == 1.0
+    assert 1.0 <= snap["fleet/restarts"] <= 3.0
+
+
+def test_spawn_fail_opens_breaker_without_eating_budget(params, gold):
+    """Respawn failures open the replica's circuit breaker: the replica
+    leaves placement (capacity degrades), the fleet restart budget stays
+    intact, and a half-open probe recovers it once spawning works."""
+    budget = RestartBudget(max_restarts=8, window_s=120.0)
+    fleet = ServingFleet(lambda name: _sched(params), replicas=2,
+                         restart_budget=budget,
+                         breaker_kwargs={"failure_threshold": 2,
+                                         "cooloff_s": 0.05})
+    samp = SamplingParams(greedy=True, max_new_tokens=GEN)
+    frs = [fleet.submit(p, sampling=samp) for p in _prompts()]
+    for _ in range(2):
+        fleet.step()
+    chaos.arm("spawn_fail", "raise", count=0)
+    try:
+        fleet.kill_replica("replica0")
+        fleet.run_until_idle(max_ticks=500)
+        snap = fleet.snapshot()
+        assert snap["fleet/breaker_opens"] >= 1.0
+        assert snap["fleet/replicas_broken"] == 1.0
+        assert not budget.exhausted()
+        # router still places on the survivor, never raises
+        fr_live = fleet.submit(_prompts()[0], sampling=samp)
+        fleet.run_until_idle(max_ticks=500)
+        assert fr_live.state == "finished" and fr_live.tokens == gold[0]
+    finally:
+        chaos.disarm("spawn_fail")
+    for i, fr in enumerate(frs):
+        assert fr.state == "finished" and fr.tokens == gold[i], (i, fr)
+    # fault cleared: cooloff elapses, the half-open probe respawns it
+    import time as _time
+    _time.sleep(0.1)
+    fleet.step()
+    assert fleet.snapshot()["fleet/replicas_broken"] == 0.0
+
+
+def test_tick_watchdog_names_batch_and_fleet_recovers(params, gold):
+    """A tick slower than tick_deadline_s raises TickDeadlineError naming
+    the packed uids; the fleet treats it as a death (reason tick_stall,
+    distinct from crash), blames exactly that batch, and recovers."""
+    sched = _sched(params, tick_deadline_s=2.0)
+    samp = SamplingParams(greedy=True, max_new_tokens=GEN)
+    req = sched.submit(_prompts()[0], sampling=samp)
+    chaos.arm("tick_stall", "sleep", sleep_s=2.2, count=1)
+    try:
+        with pytest.raises(TickDeadlineError) as ei:
+            sched.step()
+    finally:
+        chaos.disarm("tick_stall")
+    assert ei.value.uids == [req.uid]
+    assert ei.value.elapsed_s > ei.value.deadline_s
+    assert sched.tick_deadline_trips == 1
+
+    fleet = ServingFleet(lambda n: _sched(params, tick_deadline_s=3.0),
+                         replicas=2)
+    frs = [fleet.submit(p, sampling=samp) for p in _prompts()]
+    chaos.arm("tick_stall", "sleep", sleep_s=3.5, count=1)
+    try:
+        fleet.run_until_idle(max_ticks=500)
+    finally:
+        chaos.disarm("tick_stall")
+    snap = fleet.snapshot()
+    # >= not ==: a genuinely slow tick on a loaded CI host may trip the
+    # watchdog again — also a death, also recovered from
+    assert snap["fleet/deaths_tick_stall"] >= 1.0
+    for i, fr in enumerate(frs):
+        assert fr.state == "finished" and fr.tokens == gold[i], (i, fr)
+
+
+def test_replay_budget_caps_unconvicted_replays(params):
+    """Even a request the blame tracker never convicts cannot replay
+    unboundedly: past max_replays it fails reason="replay_budget".
+    (Blame thresholds raised so two kills don't convict the lone
+    in-flight request first — the cap must bind on its own.)"""
+    fleet = ServingFleet(lambda name: _sched(params), replicas=2,
+                         max_replays=1,
+                         blame=CrashBlame(suspect_after=4,
+                                          convict_after=4))
+    samp = SamplingParams(greedy=True, max_new_tokens=GEN)
+    fr = fleet.submit(_prompts()[0], sampling=samp)
+    fleet.step()
+    fleet.kill_replica(fr.replica)       # replay 1/1
+    assert not fr.done and fr.replays == 1
+    fleet.kill_replica(fr.replica)       # budget exhausted
+    assert fr.state == "failed" and fr.finish_reason == "replay_budget"
+    assert fr.error and "max_replays" in fr.error
+    assert fleet.snapshot()["fleet/replay_budget_failed"] == 1.0
+    assert fleet.num_pending == 0
+
+
+def test_fleet_overload_sheds_batch_first_with_retry_hint(params):
+    fleet = ServingFleet(
+        lambda name: _sched(params), replicas=2,
+        admission=AdmissionBudget(max_backlog_tokens=60.0))
+    samp = SamplingParams(greedy=True, max_new_tokens=GEN)
+    fleet.submit(_prompts()[0], priority_class="batch", sampling=samp)
+    with pytest.raises(OverloadShedError) as ei:
+        fleet.submit(_prompts()[1], priority_class="batch", sampling=samp)
+    assert ei.value.retry_after_s > 0
+    # the lowest class is at its ceiling; interactive still has headroom
+    fr = fleet.submit(_prompts()[1], priority_class="interactive",
+                      sampling=samp)
+    snap = fleet.snapshot()
+    assert snap["fleet/shed_total"] == 1.0
+    assert snap["fleet/shed_batch"] == 1.0
+    fleet.run_until_idle(max_ticks=300)
+    assert fr.state == "finished"
+
+
+def test_router_skips_breaker_open_replica(params):
+    s1, s2 = _sched(params), _sched(params)
+    router = CacheAwareRouter({"a": s1, "b": s2})
+    rep_a = next(r for r in router.replicas if r.name == "a")
+    rep_a.breaker = CircuitBreaker(failure_threshold=1, cooloff_s=60.0)
+    rep_a.breaker.record_failure()
+    assert not rep_a.available
+    samp = SamplingParams(greedy=True, max_new_tokens=2)
+    for _ in range(3):
+        assert router.submit(_prompts()[0], sampling=samp).replica == "b"
+    rep_b = next(r for r in router.replicas if r.name == "b")
+    rep_b.broken = True
+    with pytest.raises(RuntimeError, match="available"):
+        router.submit(_prompts()[0], sampling=samp)
+
+
+# --------------------------------------------------------------------- #
+# Deadline carryover: a killed/replayed or handed-off request resumes
+# with its REMAINING deadline, never a fresh one
+# --------------------------------------------------------------------- #
+def _live_request(fleet, uid):
+    for _, rep in fleet.pool_members():
+        sched = rep.scheduler
+        for req in [*sched._queued, *sched._running.values(),
+                    *sched._preempted]:
+            if req.uid == uid:
+                return req
+    return None
+
+
+def test_deadline_carryover_through_kill_replay(params):
+    fleet = ServingFleet(lambda name: _sched(params), replicas=2)
+    samp = SamplingParams(greedy=True, max_new_tokens=GEN)
+    fr = fleet.submit(_prompts()[0], sampling=samp, deadline_s=30.0)
+    fleet.step()
+    # burn 10s of the budget (rewind arrival on BOTH views of the clock)
+    fr.arrival -= 10.0
+    req0 = _live_request(fleet, fr.uid)
+    req0.arrival_time -= 10.0
+    fleet.kill_replica(fr.replica)
+    req1 = _live_request(fleet, fr.uid)
+    assert req1 is not None and req1 is not req0
+    # the replay resumed with the ~20s REMAINING (minus real serving
+    # time since submit), never a fresh 30s
+    assert 10.0 < req1.deadline_s < 20.5
+    fleet.run_until_idle(max_ticks=300)
+    assert fr.state == "finished"
+
+
+def test_deadline_carryover_through_rolling_restart(params):
+    fleet = ServingFleet(lambda name: _sched(params), replicas=2)
+    samp = SamplingParams(greedy=True, max_new_tokens=GEN)
+    fr = fleet.submit(_prompts()[0], sampling=samp, deadline_s=30.0)
+    fleet.step()
+    _live_request(fleet, fr.uid).arrival_time -= 10.0
+    fleet.rolling_restart(drain_deadline_s=0.0)
+    req1 = _live_request(fleet, fr.uid)
+    assert req1 is not None
+    assert 10.0 < req1.deadline_s < 20.5
+    fleet.run_until_idle(max_ticks=300)
+    assert fr.state == "finished"
+
+
+def test_deadline_carryover_through_kv_handoff(params):
+    """Disaggregated-style migration: the snapshot built the tick a
+    prefill completes carries the REMAINING deadline with the KV."""
+    a, b = _sched(params), _sched(params)
+    samp = SamplingParams(greedy=True, max_new_tokens=GEN)
+    r = a.submit(_prompts()[0], sampling=samp, deadline_s=30.0)
+    while r.uid not in a.running_decode_uids:
+        a.step()
+    r.arrival_time -= 10.0
+    snap, kv = a.extract_for_handoff(r.uid, include_kv=True)
+    assert 10.0 < snap.deadline_s < 20.5
+    r2 = b.resubmit(snap, kv_state=kv)
+    assert 10.0 < r2.deadline_s < 20.5
+    b.run_until_idle()
+    assert r2.state is RequestState.FINISHED
+
+
+# --------------------------------------------------------------------- #
 # The tier-1 chaos smoke: real subprocess workers, SIGKILL mid-decode,
 # rolling upgrade — behind a HARD timeout so a fleet bug can't hang CI.
 # --------------------------------------------------------------------- #
@@ -519,3 +875,13 @@ def test_fleet_smoke_tool():
     assert snap["kill_replayed_requests"] >= 1
     assert snap["kill_recovery_s"] < 180.0
     assert snap["upgrade_waves"] == 3
+    # defense-in-depth variants (quarantine / breaker / backpressure)
+    assert 1 <= snap["poison_respawns"] <= 3
+    assert snap["poison_deaths_journaled"] >= 1
+    assert snap["spawn_fail_breaker_opens"] >= 1
+    assert snap["spawn_fail_budget_used"] < snap["spawn_fail_budget_max"]
+    assert snap["overload_shed_batch"] > 0
+    assert snap["overload_shed_interactive"] == 0
+    assert (snap["overload_p95_interactive_ttft_loaded_s"]
+            <= max(2.0 * snap["overload_p95_interactive_ttft_unloaded_s"],
+                   0.5))

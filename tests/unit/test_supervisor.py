@@ -215,6 +215,10 @@ elif mode.startswith("crash"):       # beat, then die nonzero
     for i in range(3):
         beat(i); time.sleep(0.02)
     sys.exit(int(mode.split("_")[1]))
+elif mode == "die_unbeaten":         # die before the FIRST beat: the
+    sys.exit(11)                     # "startup" failure signature
+elif mode == "never_beat":           # alive but never beats: a startup
+    time.sleep(60)                   # stall, not a steady-state hang
 elif mode == "stall":                # alive but silent: the hang signature
     for i in range(3):
         beat(i); time.sleep(0.02)
@@ -296,6 +300,38 @@ def test_crash_detected_and_restarted(worker_script, tmp_path):
     assert restart["reason"] == "crash"
     assert (restart["world_before"], restart["world_after"]) == (2, 2)
     assert restart["backoff_s"] > 0
+
+
+def test_startup_death_reported_distinct_from_crash(worker_script,
+                                                    tmp_path):
+    """A worker that dies before its FIRST heartbeat is a "startup"
+    failure (bad binary/config), not a steady-state "crash" — circuit
+    breakers and operators must be able to tell them apart."""
+    sup = _supervisor(worker_script, tmp_path,
+                      {0: {"h0": "die_unbeaten", "h1": "slow"}, 1: {}})
+    assert sup.run(timeout=30) == 0
+    assert sup.metrics.restart_startup == 1
+    assert sup.metrics.restart_crash == 0
+    crash = _events(sup, "crash_detected")[0]
+    assert crash["rc"] == 11 and crash["reason"] == "startup"
+    restart = _events(sup, "restart")[0]
+    assert restart["reason"] == "startup"
+    assert "restart_startup" in dict(
+        (k.split("/")[-1], v) for k, v, _ in sup.metrics.export())
+
+
+def test_startup_stall_reported_as_startup_not_hang(worker_script,
+                                                    tmp_path):
+    """Alive but never beat past startup_timeout_s: also "startup" (the
+    stack dump still captures), not a steady-state hang."""
+    sup = _supervisor(worker_script, tmp_path,
+                      {0: {"h0": "never_beat", "h1": "slow"}, 1: {}},
+                      startup_timeout_s=0.5, max_restarts=3)
+    assert sup.run(timeout=30) == 0
+    assert sup.metrics.restart_startup == 1 and sup.metrics.hangs == 1
+    hang = _events(sup, "hang_detected")[0]
+    assert hang["reason"] == "startup"
+    assert _events(sup, "restart")[0]["reason"] == "startup"
 
 
 def test_hang_detected_within_2x_heartbeat_interval(worker_script, tmp_path):

@@ -1,7 +1,9 @@
-"""Fleet chaos smoke (~60-120 s CPU): prove the supervised serving fleet
-loses ZERO requests across a hard replica kill and a rolling upgrade.
+"""Fleet chaos smoke (~2-4 min CPU): prove the supervised serving fleet
+loses ZERO requests across a hard replica kill and a rolling upgrade —
+and that its defense-in-depth layer contains hostile inputs and sick
+replicas instead of cascading.
 
-Two variants over the same tiny-Llama serving workload (single-device
+Five variants over the same tiny-Llama serving workload (single-device
 engines per the jax-0.4.37 host constraint — no mesh APIs):
 
 **kill** — a 2-replica fleet of REAL subprocess workers
@@ -20,6 +22,29 @@ request exercises the handoff path, not the drain path) while new
 requests are submitted after every wave.  Asserts: admission stayed open
 (the wave submissions were accepted and finished), every request
 finished, and all streams are greedy-exact.
+
+**poison** — the same subprocess fleet, with ``DS_CHAOS`` arming a
+``poison_request`` fault (action=crash) keyed to ONE request's uid in
+every worker incarnation: a malformed request that deterministically
+kills any worker that batches it.  Asserts: the poison request is
+QUARANTINED (``failed reason="quarantined"``, tenant-visible error)
+within <= 3 worker respawns via the blame/isolation pipeline, and every
+innocent request — including ones co-batched with the poison at a crash
+— finishes greedy-exact.  Zero innocent requests lost.
+
+**spawn-fail** — an in-process fleet with ``spawn_fail`` chaos armed:
+a killed replica's every respawn attempt fails.  Asserts: the replica's
+circuit breaker OPENS (it leaves placement; probes are paced by
+cooloff) without exhausting the fleet restart budget, innocents
+migrate and finish greedy-exact, and once the fault clears a half-open
+probe respawns the replica and it serves again.
+
+**overload** — an in-process fleet behind an :class:`AdmissionBudget`
+takes a sustained 2x-overload burst of mixed interactive + batch
+traffic.  Asserts: shedding is batch-class-first (zero interactive
+sheds), every shed carries a positive retry-after hint, everything
+admitted finishes, and p95 interactive TTFT under overload stays
+within 2x of the unloaded run.
 
 Wired into tier-1 via ``tests/unit/test_fleet.py`` behind a hard
 subprocess timeout.  Run standalone::
@@ -210,6 +235,212 @@ def run_kill_variant(base: str, gold) -> dict:
 
 
 # --------------------------------------------------------------------- #
+# Variant: poison request — quarantined within <= 3 respawns, zero
+# innocent requests lost (subprocess workers, DS_CHAOS-armed crash)
+# --------------------------------------------------------------------- #
+def run_poison_variant(base: str, gold) -> dict:
+    from deepspeed_tpu.fleet import FleetFrontEnd
+    from deepspeed_tpu.resilience.supervisor import BackoffPolicy
+    from deepspeed_tpu.serving import SamplingParams
+
+    ckpt = os.path.join(base, "engine_ckpt")
+    prompts = _prompts()
+
+    def worker_argv(name, spool):
+        return [sys.executable, os.path.abspath(__file__), "--worker",
+                spool, ckpt]
+
+    # innocents take uids 1..N, the poison N+1 — armed in EVERY worker
+    # incarnation, so wherever it is replayed it kills its host, until
+    # the front-end's blame tracker isolates and convicts it
+    poison_uid = N_REQUESTS + 1
+    fe = FleetFrontEnd(
+        worker_argv, 2, os.path.join(base, "poison"),
+        heartbeat_interval_s=2.0,
+        hang_timeout_s=90.0,
+        backoff=BackoffPolicy(base_s=0.2, jitter=0.0),
+        max_restarts=4,
+        env={"JAX_PLATFORMS": "cpu",
+             "DS_CHAOS":
+                 f"poison_request:action=crash,key={poison_uid},count=0"})
+    try:
+        samp = SamplingParams(greedy=True, max_new_tokens=GEN_TOKENS)
+        frs = [fe.submit(p, sampling=samp) for p in prompts]
+        poison = fe.submit(list(range(1, 11)), sampling=samp)
+        assert poison.uid == poison_uid
+        t0 = time.monotonic()
+        frs_after = fe.run_until_idle(timeout_s=280)
+        quarantine_s = time.monotonic() - t0
+        assert fe.num_pending == 0, [
+            (fr.uid, fr.state, fr.replica, len(fr.tokens))
+            for fr in frs_after if not fr.done]
+        # the poison request is terminal with a tenant-visible verdict
+        assert poison.state == "failed" \
+            and poison.finish_reason == "quarantined", \
+            (poison.state, poison.finish_reason)
+        assert poison.error and "quarantined" in poison.error
+        assert fe.quarantined == 1
+        # ... within <= 3 worker respawns (deaths), blame-bounded
+        respawns = sum(sup.attempt for sup in fe.supervisors.values())
+        assert 1 <= respawns <= 3, respawns
+        # every innocent finished greedy-exact: zero collateral damage
+        for i, fr in enumerate(frs):
+            assert fr.state == "finished", \
+                (fr.uid, fr.state, fr.finish_reason)
+            assert fr.tokens == gold[i], \
+                f"innocent {fr.uid} diverged (replays={fr.replays})"
+        return {
+            "poison_respawns": respawns,
+            "poison_deaths_journaled": len(fe.blame.deaths),
+            "poison_quarantine_s": round(quarantine_s, 2),
+            "poison_innocent_replays": sum(fr.replays for fr in frs),
+        }
+    finally:
+        fe.stop(timeout_s=60)
+
+
+# --------------------------------------------------------------------- #
+# Variant: spawn_fail — breaker opens, restart budget survives,
+# half-open probe recovers the replica once the fault clears
+# --------------------------------------------------------------------- #
+def run_spawn_fail_variant(base: str, gold) -> dict:
+    from deepspeed_tpu.fleet import ServingFleet
+    from deepspeed_tpu.resilience import chaos
+    from deepspeed_tpu.resilience.supervisor import RestartBudget
+    from deepspeed_tpu.serving import SamplingParams
+
+    ckpt = os.path.join(base, "engine_ckpt")
+    prompts = _prompts()
+    samp = SamplingParams(greedy=True, max_new_tokens=GEN_TOKENS)
+    budget = RestartBudget(max_restarts=8, window_s=120.0)
+    fleet = ServingFleet(lambda name: _scheduler_from_checkpoint(ckpt),
+                         replicas=2, restart_budget=budget,
+                         breaker_kwargs={"failure_threshold": 2,
+                                         "cooloff_s": 0.2})
+    frs = [fleet.submit(p, sampling=samp) for p in prompts]
+    for _ in range(2):
+        fleet.step()
+    chaos.arm("spawn_fail", "raise", count=0)
+    try:
+        fleet.kill_replica("replica0")
+        fleet.run_until_idle(max_ticks=2000)
+    finally:
+        chaos.disarm("spawn_fail")
+    snap = fleet.snapshot()
+    assert snap["fleet/breaker_opens"] >= 1.0, snap
+    assert snap["fleet/replicas_broken"] == 1.0, snap
+    assert not budget.exhausted(), \
+        f"budget burned: {budget.in_window()}/{budget.max_restarts}"
+    for i, fr in enumerate(frs):
+        assert fr.state == "finished" and fr.tokens == gold[i], (i, fr)
+    # fault cleared: the half-open probe brings the replica back
+    time.sleep(0.4)
+    fr2 = fleet.submit(prompts[0], sampling=samp)
+    fleet.run_until_idle(max_ticks=2000)
+    assert fr2.state == "finished" and fr2.tokens == gold[0]
+    snap = fleet.snapshot()
+    assert snap["fleet/replicas_broken"] == 0.0
+    return {
+        "spawn_fail_breaker_opens": int(snap["fleet/breaker_opens"]),
+        "spawn_fail_budget_used": budget.in_window(),
+        "spawn_fail_budget_max": budget.max_restarts,
+    }
+
+
+# --------------------------------------------------------------------- #
+# Variant: 2x sustained overload — shed batch-class-first, interactive
+# p95 TTFT within 2x of the unloaded run
+# --------------------------------------------------------------------- #
+OVERLOAD_GEN = 8
+OVERLOAD_BUDGET_TOKENS = 100.0
+
+
+def _overload_fleet(ckpt: str):
+    from deepspeed_tpu.fleet import AdmissionBudget, ServingFleet
+
+    return ServingFleet(
+        lambda name: _scheduler_from_checkpoint(ckpt), replicas=2,
+        admission=AdmissionBudget(
+            max_backlog_tokens=OVERLOAD_BUDGET_TOKENS))
+
+
+def run_overload_variant(base: str) -> dict:
+    import numpy as np
+
+    from deepspeed_tpu.fleet import OverloadShedError
+    from deepspeed_tpu.serving import SamplingParams
+
+    ckpt = os.path.join(base, "engine_ckpt")
+    prompts = _prompts(seed=5)
+    samp = SamplingParams(greedy=True, max_new_tokens=OVERLOAD_GEN)
+
+    # unloaded reference: interactive-only at a rate the fleet absorbs
+    fleet = _overload_fleet(ckpt)
+    unloaded = []
+    for i in range(8):
+        unloaded.append(fleet.submit(prompts[i % len(prompts)],
+                                     priority_class="interactive",
+                                     sampling=samp))
+        fleet.step()
+        fleet.step()
+    fleet.run_until_idle(max_ticks=3000)
+    assert all(fr.state == "finished" for fr in unloaded)
+    p95_unloaded = float(np.percentile(
+        [fr.ttft for fr in unloaded if fr.ttft is not None], 95))
+
+    # 2x sustained burst: per wave the offered load (1 interactive + 3
+    # batch) is ~2x what the backlog budget admits — batch must shed
+    # first, and interactive latency must stay protected
+    fleet2 = _overload_fleet(ckpt)
+    inter, batch = [], []
+    sheds = {"interactive": 0, "batch": 0}
+    retry_hints = []
+    for wave in range(10):
+        for _ in range(3):
+            try:
+                batch.append(fleet2.submit(
+                    prompts[wave % len(prompts)], priority_class="batch",
+                    sampling=samp))
+            except OverloadShedError as e:
+                sheds["batch"] += 1
+                retry_hints.append(e.retry_after_s)
+        try:
+            inter.append(fleet2.submit(
+                prompts[wave % len(prompts)],
+                priority_class="interactive", sampling=samp))
+        except OverloadShedError as e:
+            sheds["interactive"] += 1
+            retry_hints.append(e.retry_after_s)
+        fleet2.step()
+        fleet2.step()
+    fleet2.run_until_idle(max_ticks=5000)
+
+    assert sheds["batch"] > 0, "no overload shedding happened — raise load"
+    assert sheds["interactive"] == 0, \
+        f"interactive shed before batch exhausted: {sheds}"
+    assert all(h > 0 for h in retry_hints)
+    for fr in [*inter, *batch]:
+        assert fr.state == "finished", (fr.uid, fr.state, fr.finish_reason)
+    snap = fleet2.snapshot()
+    assert snap["fleet/shed_batch"] == float(sheds["batch"])
+    p95_loaded = float(np.percentile(
+        [fr.ttft for fr in inter if fr.ttft is not None], 95))
+    # the entire point of class-first shedding: a bounded queue keeps
+    # interactive TTFT near unloaded (floor guards CPU timer noise)
+    assert p95_loaded <= max(2.0 * p95_unloaded, 0.5), \
+        (p95_loaded, p95_unloaded)
+    return {
+        "overload_shed_batch": sheds["batch"],
+        "overload_shed_interactive": sheds["interactive"],
+        "overload_admitted": len(inter) + len(batch),
+        "overload_p95_interactive_ttft_unloaded_s": round(p95_unloaded, 4),
+        "overload_p95_interactive_ttft_loaded_s": round(p95_loaded, 4),
+        "overload_retry_hint_p50_s": round(
+            float(np.percentile(retry_hints, 50)), 3),
+    }
+
+
+# --------------------------------------------------------------------- #
 # Variant 2: rolling upgrade, in-process, admission open throughout
 # --------------------------------------------------------------------- #
 def run_upgrade_variant(base: str, gold) -> dict:
@@ -262,6 +493,9 @@ def run_smoke(tmpdir: str | None = None) -> dict:
     snap = {}
     snap.update(run_kill_variant(tmpdir, gold))
     snap.update(run_upgrade_variant(tmpdir, gold))
+    snap.update(run_poison_variant(tmpdir, gold))
+    snap.update(run_spawn_fail_variant(tmpdir, gold))
+    snap.update(run_overload_variant(tmpdir))
     return snap
 
 
