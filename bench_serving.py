@@ -475,11 +475,171 @@ def measure_scheduler(n_requests: int = 32, rate_rps: float = 16.0,
     }
 
 
+def _spec_extra(schedulers, draft_k: int) -> dict:
+    """Aggregate speculative COUNTERS across schedulers and derive the
+    reportable rates once (summing per-scheduler rates is meaningless)."""
+    tot = {"ticks": 0, "drafted": 0, "accepted": 0, "emitted": 0}
+    for sched in schedulers:
+        st = sched.spec_stats
+        for k in tot:
+            tot[k] += int(getattr(st, k))
+    return {
+        "speculative": True,
+        "draft_k": draft_k,
+        "accept_rate": round(tot["accepted"] / max(tot["drafted"], 1), 4),
+        "tokens_per_weight_pass": round(
+            tot["emitted"] / max(tot["ticks"], 1), 3),
+        "spec_ticks": tot["ticks"],
+    }
+
+
+def measure_speculative(draft_k: int = 4, n_requests: int = 12,
+                        rate_rps: float = 16.0, prompt_len: int = 192,
+                        gen_tokens: int = 48, clients: int = 8,
+                        block_size: int = 128, seed: int = 0):
+    """Speculative-decoding serving benchmark: the scheduler-mode Poisson
+    workload run twice over the 125M GQA geometry — a non-speculative
+    baseline, then with the n-gram self-drafter + K-draft multi-token
+    verify — asserting greedy output is BIT-IDENTICAL between the two
+    and reporting accept-rate, tokens-per-weight-pass, and effective
+    tok/s A/B.
+
+    Prompts carry a repeated phrase (the retrieval/summarisation shape
+    prompt-lookup drafting exists for) so the drafter has material; the
+    accept-rate reported is measured, not assumed.
+
+    Runs in f32: the bit-parity assertion is the whole point of the
+    A/B, and bitwise logits equality across the decode and verify
+    programs is the f32 contract (same contract preempt/recompute
+    resume relies on).  bf16 rounds near-ties differently across
+    program shapes — the exact caveat ``measure_shared_prefix``
+    documents for warm-vs-cold bucket programs — so a bf16 parity
+    assert would flake on ties, not on real divergence.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.serving import (ContinuousBatchScheduler,
+                                       SamplingParams, SpeculativeConfig)
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                      intermediate_size=2048, num_hidden_layers=12,
+                      num_attention_heads=6, num_key_value_heads=2,
+                      max_position_embeddings=2048, dtype=jnp.float32)
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.key(0), np.zeros((1, 8), np.int32))["params"]
+
+    # K lookahead slots of context headroom: without them the last
+    # gen_tokens' verify passes fail can_schedule and silently fall
+    # back to plain decode, skewing accept-rate low at exactly the
+    # large K values --draft-k exists to sweep
+    max_ctx = prompt_len + gen_tokens + draft_k + 1 + 8
+    per_seq_blocks = -(-max_ctx // block_size)
+    num_blocks = clients * per_seq_blocks + 1
+
+    def make_engine():
+        eng_cfg = RaggedInferenceEngineConfig.from_dict({
+            "state_manager": {"max_ragged_batch_size": 512,
+                              "max_ragged_sequence_count": clients,
+                              "max_context": max_ctx},
+            "kv_cache": {"block_size": block_size,
+                         "num_blocks": num_blocks},
+        })
+        return InferenceEngineV2(RaggedLlama(cfg, block_size), params,
+                                 eng_cfg)
+
+    rng = np.random.default_rng(seed)
+    phrase_len = 24
+    prompts = []
+    for _ in range(n_requests):
+        phrase = rng.integers(0, cfg.vocab_size,
+                              size=(phrase_len,)).tolist()
+        reps = prompt_len // phrase_len
+        tail = rng.integers(0, cfg.vocab_size,
+                            size=(prompt_len - reps * phrase_len,)).tolist()
+        prompts.append(phrase * reps + tail)
+    sampling = SamplingParams(greedy=True, max_new_tokens=gen_tokens)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, size=n_requests))
+
+    def run(spec):
+        # warm and measure over the SAME engine (jit programs cache on
+        # the engine) — the speculative arm compiles strictly more
+        # programs than the baseline, so compiling inside the measured
+        # window would deflate vs_baseline by compile time
+        eng = make_engine()
+        warm = ContinuousBatchScheduler(eng, speculative=spec)
+        n_warm = min(clients, n_requests)
+        warm.run_with_arrivals(prompts[:n_warm], [0.0] * n_warm,
+                               sampling=sampling)
+        sched = ContinuousBatchScheduler(eng, speculative=spec)
+        t0 = time.perf_counter()
+        reqs = sched.run_with_arrivals(prompts, arrivals,
+                                       sampling=sampling)
+        wall = time.perf_counter() - t0
+        bad = [r for r in reqs if r.state.value != "finished"]
+        assert not bad, [(r.uid, r.state.value, r.finish_reason)
+                         for r in bad]
+        return sched, [r.generated for r in reqs], wall
+
+    base_sched, base_out, base_wall = run(None)
+    spec_cfg = SpeculativeConfig(draft_k=draft_k)
+    spec_sched, spec_out, spec_wall = run(spec_cfg)
+    # the acceptance rule reuses the (seed, uid, position)-keyed sampler:
+    # greedy output must be bit-identical at every K
+    assert spec_out == base_out, \
+        "speculative greedy output diverged from the baseline"
+
+    st = spec_sched.spec_stats
+    base_snap = base_sched.metrics.snapshot()
+    spec_snap = spec_sched.metrics.snapshot()
+    total_tokens = sum(len(o) for o in spec_out)
+    eff_tok_s = total_tokens / spec_wall
+    base_tok_s = total_tokens / base_wall
+
+    return {
+        "metric": "serving_speculative_decode_tokens_per_sec",
+        "value": round(eff_tok_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(eff_tok_s / max(base_tok_s, 1e-9), 4),
+        "extra": {
+            "draft_k": draft_k,
+            "dtype": "float32",
+            "greedy_bit_identical": True,
+            "accept_rate": round(st.accept_rate, 4),
+            "tokens_per_weight_pass": round(st.tokens_per_pass, 3),
+            "tokens_per_request_tick": round(
+                spec_snap.get("tokens_per_request_tick", 1.0), 3),
+            "spec_ticks": int(st.ticks),
+            "fallback_ticks": int(st.fallback_ticks),
+            "drafted": int(st.drafted),
+            "accepted": int(st.accepted),
+            "baseline_tok_s": round(base_tok_s, 1),
+            "effective_tok_s": round(eff_tok_s, 1),
+            "tpot_delivered_ms": round(
+                1000 * spec_snap.get("tpot_delivered_s", 0.0), 3),
+            "baseline_tpot_delivered_ms": round(
+                1000 * base_snap.get("tpot_delivered_s", 0.0), 3),
+            "n_requests": n_requests,
+            "prompt_len": prompt_len,
+            "gen_tokens": gen_tokens,
+            "max_concurrency": clients,
+            "wall_s": round(spec_wall, 2),
+            "baseline_wall_s": round(base_wall, 2),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
 def measure_shared_prefix(n_requests: int = 64, tenants: int = 4,
                           shared_prefix_ratio: float = 0.9,
                           prompt_len: int = 256, gen_tokens: int = 16,
                           clients: int = 8, block_size: int = 32,
-                          replicas: int = 2, seed: int = 0):
+                          replicas: int = 2, seed: int = 0,
+                          speculative: bool = False, draft_k: int = 4):
     """Shared-prefix serving workload: per-tenant prompt pools behind the
     cache-aware router, measuring what the radix prefix cache buys.
 
@@ -500,7 +660,8 @@ def measure_shared_prefix(n_requests: int = 64, tenants: int = 4,
     from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
     from deepspeed_tpu.serving import (CacheAwareRouter,
                                        ContinuousBatchScheduler,
-                                       SamplingParams)
+                                       SamplingParams, SpeculativeConfig,
+                                       make_self_drafter)
 
     cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
                       intermediate_size=2048, num_hidden_layers=12,
@@ -510,7 +671,8 @@ def measure_shared_prefix(n_requests: int = 64, tenants: int = 4,
         jax.random.key(0), np.zeros((1, 8), np.int32))["params"]
     params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
 
-    max_ctx = prompt_len + gen_tokens + 8
+    max_ctx = prompt_len + gen_tokens + (draft_k + 1 if speculative
+                                         else 0) + 8
     per_seq = -(-max_ctx // block_size)
     prefix_blocks = -(-prompt_len // block_size)
     # room for all live sequences plus every tenant's warm prefix
@@ -526,7 +688,10 @@ def measure_shared_prefix(n_requests: int = 64, tenants: int = 4,
     def make_sched():
         eng = InferenceEngineV2(RaggedLlama(cfg, block_size), params,
                                 eng_cfg)
-        return ContinuousBatchScheduler(eng)
+        spec = SpeculativeConfig(
+            draft_k=draft_k,
+            drafter=make_self_drafter(eng)) if speculative else None
+        return ContinuousBatchScheduler(eng, speculative=spec)
 
     rng = np.random.default_rng(seed)
     shared_len = int(shared_prefix_ratio * prompt_len)
@@ -608,6 +773,10 @@ def measure_shared_prefix(n_requests: int = 64, tenants: int = 4,
     saved_pct = 100.0 * agg["hit_tokens"] / max(all_prompt_tokens, 1)
     p50 = lambda v: float(np.percentile(v, 50))  # noqa: E731
 
+    spec_extra = _spec_extra(
+        [rep.scheduler for rep in router.replicas],
+        draft_k) if speculative else {}
+
     cold, warm = p50(cold_ttft_ms), p50(warm_ttft_ms)
     return {
         "metric": "serving_shared_prefix_cache",
@@ -615,6 +784,7 @@ def measure_shared_prefix(n_requests: int = 64, tenants: int = 4,
         "unit": "% prefill tokens saved",
         "vs_baseline": round(saved_pct / 100.0, 4),
         "extra": {
+            **spec_extra,
             "shared_prefix_ratio": shared_prefix_ratio,
             "tenants": tenants,
             "n_requests": n_requests,
@@ -647,7 +817,8 @@ def measure_fleet(n_replicas: int = 2, disaggregate: str | None = None,
                   n_requests: int = 32, rate_rps: float = 16.0,
                   prompt_len: int = 192, gen_tokens: int = 48,
                   clients: int = 8, block_size: int = 128,
-                  tenants: int = 4, seed: int = 0):
+                  tenants: int = 4, seed: int = 0,
+                  speculative: bool = False, draft_k: int = 4):
     """Fleet-mode serving benchmark: the full ``deepspeed_tpu.fleet``
     stack — N replicas behind the cache-aware router — under the
     existing Poisson workload (or the ``--shared-prefix`` per-tenant
@@ -668,7 +839,8 @@ def measure_fleet(n_replicas: int = 2, disaggregate: str | None = None,
     from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
     from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
     from deepspeed_tpu.serving import (ContinuousBatchScheduler,
-                                       SamplingParams)
+                                       SamplingParams, SpeculativeConfig,
+                                       make_self_drafter)
 
     cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
                       intermediate_size=2048, num_hidden_layers=12,
@@ -695,7 +867,8 @@ def measure_fleet(n_replicas: int = 2, disaggregate: str | None = None,
                             size=(prompt_len - shared_len,)).tolist()
         return tenant, pools[tenant] + tail
 
-    max_ctx = prompt_len + gen_tokens + 8
+    max_ctx = prompt_len + gen_tokens + (draft_k + 1 if speculative
+                                         else 0) + 8
     per_seq = -(-max_ctx // block_size)
     num_blocks = clients * per_seq \
         + tenants * (-(-prompt_len // block_size)) + 1
@@ -710,9 +883,12 @@ def measure_fleet(n_replicas: int = 2, disaggregate: str | None = None,
                          **({"enable_prefix_cache": True}
                             if shared_prefix else {})},
         })
-        return ContinuousBatchScheduler(
-            InferenceEngineV2(RaggedLlama(cfg, block_size), params,
-                              eng_cfg))
+        eng = InferenceEngineV2(RaggedLlama(cfg, block_size), params,
+                                eng_cfg)
+        spec = SpeculativeConfig(
+            draft_k=draft_k,
+            drafter=make_self_drafter(eng)) if speculative else None
+        return ContinuousBatchScheduler(eng, speculative=spec)
 
     if disaggregate:
         p, d = (int(x) for x in disaggregate.split(":"))
@@ -767,12 +943,17 @@ def measure_fleet(n_replicas: int = 2, disaggregate: str | None = None,
     snap = fleet.snapshot()
     pct = lambda v, q: (float(np.percentile(v, q)) if v else 0.0)  # noqa: E731
 
+    spec_extra = _spec_extra(
+        [rep.scheduler for _pool, rep in fleet.pool_members()],
+        draft_k) if speculative else {}
+
     return {
         "metric": "serving_fleet_goodput_tokens_per_sec",
         "value": round(goodput, 1),
         "unit": "tokens/s",
         "vs_baseline": round(goodput / (0.5 * roofline_tok_s), 4),
         "extra": {
+            **spec_extra,
             "replicas": int(snap["fleet/replicas"]),
             "mode": (f"disaggregated {disaggregate}" if disaggregate
                      else f"colocated x{n_replicas}"),
@@ -819,13 +1000,24 @@ if __name__ == "__main__":
     if _disagg is not None and not _fleet:
         raise SystemExit("bench_serving: --disaggregate P:D requires "
                          "--fleet N")
-    # --shared-prefix composes with --fleet (it selects the fleet's
-    # workload); every other pairing is a conflict
+    _speculative = "--speculative" in sys.argv
+    _draft_k_given = any(a == "--draft-k" or a.startswith("--draft-k=")
+                         for a in sys.argv)
+    _draft_k = int(_cli_float("--draft-k", 4))
+    if _draft_k_given and not _speculative:
+        raise SystemExit("bench_serving: --draft-k K requires "
+                         "--speculative")
+    # --shared-prefix and --speculative compose with --fleet (they select
+    # the fleet's workload / decode mode) and with each other; every
+    # other pairing is a conflict
     _modes = [f for f, on in [("--7b", "--7b" in sys.argv),
                               ("--scheduler", "--scheduler" in sys.argv),
                               ("--fleet", _fleet),
                               ("--shared-prefix",
-                               _shared_prefix and not _fleet)] if on]
+                               _shared_prefix and not _fleet),
+                              ("--speculative",
+                               _speculative and not _fleet
+                               and not _shared_prefix)] if on]
     if len(_modes) > 1:
         raise SystemExit(f"bench_serving: pick one mode, got {_modes}")
     try:
@@ -843,11 +1035,15 @@ if __name__ == "__main__":
                 disaggregate=_disagg,
                 shared_prefix=_shared_prefix,
                 shared_prefix_ratio=_cli_float("--shared-prefix-ratio",
-                                               0.9))))
+                                               0.9),
+                speculative=_speculative, draft_k=_draft_k)))
         elif _shared_prefix:
             print(json.dumps(measure_shared_prefix(
                 shared_prefix_ratio=_cli_float("--shared-prefix-ratio",
-                                               0.9))))
+                                               0.9),
+                speculative=_speculative, draft_k=_draft_k)))
+        elif _speculative:
+            print(json.dumps(measure_speculative(draft_k=_draft_k)))
         else:
             main()
     except Exception as e:  # noqa: BLE001 — always emit a JSON record
@@ -862,6 +1058,8 @@ if __name__ == "__main__":
                   if _fleet
                   else "serving_shared_prefix_cache"
                   if _shared_prefix
+                  else "serving_speculative_decode_tokens_per_sec"
+                  if _speculative
                   else "fastgen_decode_tokens_per_sec_125m")
         print(json.dumps({"metric": metric,
                           "value": 0, "unit": "tokens/s/chip",

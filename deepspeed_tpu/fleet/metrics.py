@@ -160,6 +160,9 @@ class FleetMetrics:
         goodput = 0.0
         agg = {"submitted": 0.0, "finished": 0.0, "failed": 0.0,
                "preemptions": 0.0, "total_tokens": 0.0}
+        spec = {"ticks": 0.0, "drafted": 0.0, "accepted": 0.0,
+                "emitted": 0.0}
+        speculating = False
         for pool, reps in pools.items():
             out[f"fleet/replicas_{pool}"] = float(len(reps))
             out[f"fleet/queue_depth_{pool}"] = float(
@@ -171,9 +174,21 @@ class FleetMetrics:
                 goodput += m.goodput_tokens_per_s()
                 for k in agg:
                     agg[k] += float(getattr(m, k))
+                if getattr(r.scheduler, "speculative", None) is not None:
+                    speculating = True
+                    st = r.scheduler.spec_stats
+                    for k in spec:
+                        spec[k] += float(getattr(st, k))
         out["fleet/goodput_tokens_per_s"] = goodput
         for k, v in agg.items():
             out[f"fleet/{k}"] = v
+        if speculating:
+            # journal-consistent accounting: delivered TOKENS, not
+            # ticks — variable acceptance means ticks say nothing
+            for k, v in spec.items():
+                out[f"fleet/spec_{k}"] = v
+            out["fleet/spec_accept_rate"] = (
+                spec["accepted"] / max(spec["drafted"], 1.0))
         for k, v in fleet.router.snapshot().items():
             out[f"fleet/router_{k}"] = float(v)
         return out

@@ -56,6 +56,29 @@ def _device_decode_batch(tables, pos, tok, block_size: int,
     }
 
 
+def _device_verify_batch(tables, pos, tok, block_size: int,
+                         max_blocks: int, k_tokens: int):
+    """Ragged batch dict for a speculative VERIFY round: ``k_tokens``
+    consecutive-position tokens per slot (the fed token plus the drafted
+    lookahead), rows slot-major, with ``logits_idx`` selecting EVERY row
+    so the forward returns all K candidate logits per sequence."""
+    S = tables.shape[0]
+    slot = jnp.repeat(jnp.arange(S, dtype=jnp.int32), k_tokens)
+    p2 = pos[:, None] + jnp.arange(k_tokens, dtype=jnp.int32)[None, :]
+    blk = jnp.take_along_axis(
+        tables, jnp.clip(p2 // block_size, 0, max_blocks - 1), axis=1)
+    p = p2.reshape(-1)
+    return {
+        "token_ids": tok.reshape(-1),
+        "token_slot": slot,
+        "token_pos": p,
+        "kv_dest": blk.reshape(-1) * block_size + p % block_size,
+        "block_tables": tables,
+        "context_lens": pos + k_tokens,
+        "logits_idx": jnp.arange(S * k_tokens, dtype=jnp.int32),
+    }
+
+
 def _pack_tables_positions(seqs, max_seqs: int, max_blocks: int):
     """Host-side [S, B] block table + [S] position arrays for live decode
     sequences (trash-padded), shared by ``decode_loop`` and
@@ -407,21 +430,7 @@ class InferenceEngineV2:
                 self.params, sm.kv_cache.cache, state["tables"],
                 state["pos"], self._as_token_array(tokens, n, S))
         except Exception:
-            # the jitted step donates the cache and pos buffers; if it
-            # raises after donation both may reference consumed arrays.
-            # The KV content is unrecoverable at that point — drop the
-            # cached decode state, reallocate a zeroed cache, and flush
-            # every live sequence so subsequent calls start clean instead
-            # of passing deleted buffers.
-            self._dev_decode_state = None
-            for leaf in jax.tree_util.tree_leaves(sm.kv_cache.cache):
-                if getattr(leaf, "is_deleted", lambda: False)():
-                    sm.kv_cache.update(jax.tree_util.tree_map(
-                        jnp.zeros_like, sm.kv_cache.cache))
-                    sm.flush(list(sm._seqs))
-                    if sm.prefix_cache is not None:
-                        sm.prefix_cache.clear()   # cached KV is gone too
-                    break
+            self._recover_donated_cache()
             raise
         sm.kv_cache.update(new_cache)
         host_toks = (None if isinstance(tokens, jax.Array)
@@ -438,6 +447,25 @@ class InferenceEngineV2:
         if greedy:
             return logits, nxt
         return logits
+
+    def _recover_donated_cache(self) -> None:
+        """A jitted step that donates the KV cache raised after donation
+        — the cache may reference consumed arrays and its content is
+        unrecoverable.  Drop the cached decode state, reallocate a
+        zeroed cache, and flush every live sequence so subsequent calls
+        start clean instead of passing deleted buffers.  Shared by
+        :meth:`decode_step` and :meth:`verify_step` (with speculation
+        enabled the verify pass IS the steady-state tick)."""
+        sm = self.state_manager
+        self._dev_decode_state = None
+        for leaf in jax.tree_util.tree_leaves(sm.kv_cache.cache):
+            if getattr(leaf, "is_deleted", lambda: False)():
+                sm.kv_cache.update(jax.tree_util.tree_map(
+                    jnp.zeros_like, sm.kv_cache.cache))
+                sm.flush(list(sm._seqs))
+                if sm.prefix_cache is not None:
+                    sm.prefix_cache.clear()   # cached KV is gone too
+                break
 
     def _as_token_array(self, tokens, n: int, S: int) -> jax.Array:
         if isinstance(tokens, jax.Array):
@@ -470,6 +498,153 @@ class InferenceEngineV2:
             return logits, nxt, new_cache, pos + 1
 
         runner = jax.jit(run, donate_argnums=(1, 3))
+        self._steps[key] = runner
+        return runner
+
+    # ------------------------------------------------------------------ #
+    # Speculative decoding: multi-token verify (ROADMAP item 1).  One
+    # weight pass scores K candidate positions per sequence — the fed
+    # token plus K-1 drafted lookahead tokens — and returns ALL K logits
+    # rows, so the caller's sampler can accept the longest matching draft
+    # prefix plus one bonus/correction token.  KV for every fed token is
+    # written at its position; rejected lookahead rows are either
+    # overwritten by the next real feed at that position (never attended
+    # before then — the causal mask stops at each token's own position)
+    # or, when they spilled into freshly allocated lookahead blocks,
+    # rolled back by commit_verified's block trim.
+    # ------------------------------------------------------------------ #
+    def verify_step(self, uids: Sequence[int],
+                    tokens: Sequence[Sequence[int]],
+                    greedy: bool = False):
+        """Score ``tokens[i]`` (K fed tokens for ``uids[i]``: its next
+        input token followed by K-1 drafts) in ONE forward.
+
+        Every row must have the same length K (one compiled program per
+        K).  Each sequence must be live with no pending prompt tokens.
+        Neither ``seen_tokens`` nor the host token record advances here —
+        the caller decides acceptance from the returned logits and then
+        calls :meth:`commit_verified` with the accepted feed prefix.
+
+        Returns logits ``[max_seqs, K, vocab]`` as a device array
+        WITHOUT host synchronisation (rows ``>= len(uids)`` are
+        padding): row ``[i, k]`` is the distribution after consuming
+        ``tokens[i][:k+1]`` — identical (up to kernel rounding;
+        bit-exact on the f32 CPU path) to what K sequential
+        :meth:`decode_step` calls would return while the drafts match.
+
+        ``greedy=True`` returns ``(logits, next_tokens [max_seqs, K])``
+        with the argmax computed INSIDE the step program — an all-greedy
+        caller fetches K ints per sequence instead of K vocab rows
+        (the same asymmetry :meth:`decode_step`'s greedy mode exploits).
+        """
+        sm = self.state_manager
+        S, B = self._batch.max_seqs, self._max_blocks
+        n = len(uids)
+        if n == 0 or n != len(tokens):
+            raise ValueError(
+                f"verify_step: {n} uids but {len(tokens)} token rows")
+        K = len(tokens[0])
+        if K < 1 or any(len(t) != K for t in tokens):
+            raise ValueError(
+                "verify_step: all rows must share one draft length K >= 1")
+        if n > S:
+            raise ValueError(f"verify_step: {n} sequences exceed "
+                             f"max_seqs {S}")
+        max_context = self.config.state_manager.max_context
+        seqs = []
+        for uid in uids:
+            seq = sm.get_sequence(uid)
+            if seq is None or seq.pending:
+                raise RuntimeError(
+                    f"verify_step: sequence {uid} missing or has pending "
+                    f"prompt tokens — run put() first")
+            if seq.seen_tokens + K > max_context:
+                raise RuntimeError(
+                    f"verify_step: sequence {uid} would exceed "
+                    f"max_context {max_context} with {K} lookahead slots")
+            sm.maybe_allocate_kv(seq, K)      # K lookahead KV slots
+            seqs.append(seq)
+
+        tables, pos = _pack_tables_positions(seqs, S, B)
+        tok = np.zeros((S, K), np.int32)
+        tok[:n] = np.asarray([[int(t) for t in row] for row in tokens],
+                             np.int32)
+        packed = jnp.asarray(np.concatenate(
+            [tables.ravel(), pos, tok.ravel()]))       # ONE upload
+        try:
+            logits, nxt, new_cache = self._get_verify_step(K)(
+                self.params, sm.kv_cache.cache, packed)
+        except Exception:
+            # same donated-cache hazard as decode_step: with speculation
+            # on, THIS is the steady-state tick, so it needs the same
+            # clean-reset path
+            self._recover_donated_cache()
+            raise
+        sm.kv_cache.update(new_cache)
+        # lookahead positions moved under any cached decode tables
+        self._dev_decode_state = None
+        if greedy:
+            return logits, nxt
+        return logits
+
+    def commit_verified(self, uid: int,
+                        accepted_tokens: Sequence[int]) -> None:
+        """Advance ``uid`` past the accepted prefix of its last
+        :meth:`verify_step` feed (KV for those tokens is already
+        written), and ROLL BACK the rejected lookahead: blocks allocated
+        past what ``seen_tokens`` now needs are freed, so the allocator
+        and refcounts end exactly where a never-drafted run would be.
+        Accepted draft tokens are recorded host-side and full blocks
+        register into the radix prefix cache as warm blocks, same as any
+        other fed token."""
+        sm = self.state_manager
+        seq = sm.get_sequence(uid)
+        if seq is None:
+            raise ValueError(f"commit_verified: unknown sequence {uid}")
+        a = len(accepted_tokens)
+        if a < 1:
+            raise ValueError(
+                "commit_verified: at least the fed input token is always "
+                "accepted (verify emits >= 1 token)")
+        sm.record_fed_tokens(seq, accepted_tokens)
+        seq.seen_tokens += a
+        need = -(-seq.seen_tokens // sm.block_size)
+        if len(seq.blocks) > need:
+            sm.allocator.free(seq.blocks[need:])
+            del seq.blocks[need:]
+        sm.register_prefix(seq)
+        self._dev_decode_state = None
+
+    def _get_verify_step(self, k_tokens: int):
+        key = ("verify_step", k_tokens)
+        runner = self._steps.get(key)
+        if runner is not None:
+            return runner
+        S, B = self._batch.max_seqs, self._max_blocks
+        bs = self.state_manager.block_size
+        # verify_k is a perf hint (TPU kernel routing); models without
+        # the parameter still score verify batches correctly through
+        # their generic ragged attention path
+        import inspect
+
+        try:
+            accepts_k = "verify_k" in inspect.signature(
+                self.model.__call__).parameters
+        except (TypeError, ValueError):
+            accepts_k = False
+        kwargs = {"verify_k": k_tokens} if accepts_k else {}
+
+        def run(params, cache, packed):
+            tables = packed[:S * B].reshape(S, B)
+            pos = packed[S * B:S * B + S]
+            tok = packed[S * B + S:].reshape(S, k_tokens)
+            batch = _device_verify_batch(tables, pos, tok, bs, B, k_tokens)
+            logits, new_cache = self.model(params, cache, batch, **kwargs)
+            logits = logits.reshape(S, k_tokens, -1)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return logits, nxt, new_cache
+
+        runner = jax.jit(run, donate_argnums=(1,))
         self._steps[key] = runner
         return runner
 

@@ -5,7 +5,9 @@ from deepspeed_tpu.inference.v2.kernels.blocked_flash import (
     paged_attention_usable,
     paged_decode_attention,
     paged_prefill_attention,
+    paged_verify_attention,
 )
 
 __all__ = ["paged_attention", "paged_attention_usable",
-           "paged_decode_attention", "paged_prefill_attention"]
+           "paged_decode_attention", "paged_prefill_attention",
+           "paged_verify_attention"]

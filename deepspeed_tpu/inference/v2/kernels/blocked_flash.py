@@ -240,6 +240,167 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
 
 
 # ===================================================================== #
+# Multi-token VERIFY kernel (speculative decoding): the decode kernel's
+# O(live-context) manual-DMA walk, but with K query rows per sequence —
+# the fed token plus K-1 drafted lookahead tokens at consecutive
+# positions.  One weight pass scores all K candidate positions: the HBM
+# block DMAs are shared across the K rows (the whole point — K tokens
+# per Σ live-context read instead of K separate walks), and each row k
+# carries its own causal frontier ``pos0 + k``.  This is what lets a
+# bandwidth-bound 7B decode emit >1 token per weight stream, and what
+# amortises the per-step dispatch cost that dominates 125M decode.
+# ===================================================================== #
+def _verify_kernel(token_slot, token_pos, tables, q_ref, k_hbm, v_hbm,
+                   o_ref, k_buf, v_buf, sems, *, block_size, scale,
+                   window, k_tokens):
+    t = pl.program_id(0)
+    pos0 = token_pos[t]                   # first fed position (0 on pads)
+    slot = token_slot[t]
+    last = pos0 + k_tokens - 1            # deepest causal frontier
+    hi = last // block_size + 1
+    lo = 0
+    if window is not None:
+        lo = jnp.maximum(0, (pos0 - window + 1) // block_size)
+    n = hi - lo
+
+    qf = q_ref[0].astype(jnp.float32)     # [K*H, D], row k*H+h
+    h = qf.shape[0] // k_tokens
+    d = qf.shape[1]
+    hkv = k_buf.shape[2]
+    g = h // hkv
+
+    def dma(buf, hbm, sl, j, which):
+        return pltpu.make_async_copy(
+            hbm.at[tables[slot, j]], buf.at[sl], sems.at[sl, which])
+
+    @pl.when(n > 0)
+    def _():
+        dma(k_buf, k_hbm, 0, lo, 0).start()
+        dma(v_buf, v_hbm, 0, lo, 1).start()
+
+    def body(i, carry):
+        m_prev, l_prev, acc = carry       # [K*H,1], [K*H,1], [K*H,D]
+        j = lo + i
+        sl = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n)
+        def _():
+            nsl = jax.lax.rem(i + 1, 2)
+            dma(k_buf, k_hbm, nsl, j + 1, 0).start()
+            dma(v_buf, v_hbm, nsl, j + 1, 1).start()
+
+        dma(k_buf, k_hbm, sl, j, 0).wait()
+        dma(v_buf, v_hbm, sl, j, 1).wait()
+        k = k_buf[sl].astype(jnp.float32)             # [bs, Hkv, D]
+        v = v_buf[sl].astype(jnp.float32)
+        ms, ls, accs = [], [], []
+        for kq in range(k_tokens):        # static unroll: K is small
+            q = qf[kq * h:(kq + 1) * h]               # [H, D]
+            qg = q.reshape(hkv, g, d)
+            s = jax.lax.dot_general(
+                qg, k.transpose(1, 2, 0), (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32) * scale   # [Hkv,g,bs]
+            key_pos = j * block_size + jax.lax.broadcasted_iota(
+                jnp.int32, (hkv, g, block_size), 2)
+            keep = key_pos <= pos0 + kq   # row k's own causal frontier
+            if window is not None:
+                keep = jnp.logical_and(keep, key_pos > pos0 + kq - window)
+            s = jnp.where(keep, s, NEG_INF)
+            sh = s.reshape(h, block_size)
+            mp = m_prev[kq * h:(kq + 1) * h]
+            m_cur = jnp.max(sh, axis=1, keepdims=True)
+            m_new = jnp.maximum(mp, m_cur)
+            p = jnp.exp(sh - m_new)                   # [H, bs]
+            corr = jnp.exp(mp - m_new)
+            ls.append(l_prev[kq * h:(kq + 1) * h] * corr
+                      + jnp.sum(p, axis=1, keepdims=True))
+            pg = p.reshape(hkv, g, block_size)
+            out = jax.lax.dot_general(
+                pg, v.transpose(1, 0, 2), (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)   # [Hkv, g, D]
+            accs.append(acc[kq * h:(kq + 1) * h] * corr
+                        + out.reshape(h, d))
+            ms.append(m_new)
+        return (jnp.concatenate(ms, axis=0), jnp.concatenate(ls, axis=0),
+                jnp.concatenate(accs, axis=0))
+
+    kh = k_tokens * h
+    m0 = jnp.full((kh, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((kh, 1), jnp.float32)
+    acc0 = jnp.zeros((kh, d), jnp.float32)
+    _m, l, acc = jax.lax.fori_loop(0, n, body, (m0, l0, acc0))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_size", "k_tokens", "window",
+                                    "interpret"))
+def paged_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray,
+                           block_tables: jnp.ndarray,
+                           token_slot: jnp.ndarray,
+                           token_pos: jnp.ndarray,
+                           *, block_size: int, k_tokens: int,
+                           window: Any = None,
+                           interpret: Any = None) -> jnp.ndarray:
+    """Multi-query paged attention for speculative verify batches.
+
+    q: [T, H, D] with ``T = S * k_tokens`` and rows slot-major — row
+    ``s * k_tokens + k`` is slot ``s``'s k-th lookahead token, at
+    position ``token_pos[s * k_tokens] + k``.  token_slot/token_pos are
+    the row-level [T] arrays the generic kernels take (each slot's K
+    rows share a slot id and carry consecutive positions).  Returns
+    [T, H, D]; pad slots give garbage-but-finite rows.
+    """
+    t_count, h, d = q.shape
+    s_count = t_count // k_tokens
+    hkv = k_pool.shape[1]
+    nb = k_pool.shape[0] // block_size
+    if interpret is None:
+        try:
+            interpret = jax.devices()[0].platform != "tpu"
+        except Exception:  # noqa: BLE001
+            interpret = True
+
+    kp = k_pool.reshape(nb, block_size, hkv, d)
+    vp = v_pool.reshape(nb, block_size, hkv, d)
+    scale = 1.0 / (d ** 0.5)
+    # per-slot metadata: the first row of each K-group drives the walk
+    slot0 = token_slot.reshape(s_count, k_tokens)[:, 0].astype(jnp.int32)
+    pos0 = token_pos.reshape(s_count, k_tokens)[:, 0].astype(jnp.int32)
+    qf = q.reshape(s_count, k_tokens * h, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s_count,),
+        in_specs=[
+            pl.BlockSpec((1, k_tokens * h, d),
+                         lambda t, slot, pos, tab: (t, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, k_tokens * h, d),
+                               lambda t, slot, pos, tab: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, hkv, d), k_pool.dtype),
+            pltpu.VMEM((2, block_size, hkv, d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(_verify_kernel, block_size=block_size,
+                               scale=scale, window=window,
+                               k_tokens=k_tokens)
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_count, k_tokens * h, d),
+                                       q.dtype),
+        interpret=bool(interpret),
+    )(slot0, pos0, block_tables.astype(jnp.int32), qf, kp, vp)
+    return out.reshape(t_count, h, d)
+
+
+# ===================================================================== #
 # Tiled prefill (reference ragged_ops/atom_builder + blocked_flash: work
 # units are "atoms" = a q-tile of consecutive same-sequence tokens x a KV
 # block range). The engine packs prefill chunks TILE-ALIGNED in the token
@@ -502,6 +663,27 @@ def _dslint_paged_decode_dma_case():
     bs, kp, vp, tables, slot, pos, q = _dslint_paged_setup(128)
     paged_decode_attention(q, kp, vp, tables, slot, pos, block_size=bs,
                            interpret=True)
+
+
+@pallas_kernel_case(
+    "paged_verify_multiquery",
+    note="speculative multi-token verify: K=4 query rows per sequence "
+         "share the decode kernel's O(live-context) block walk (KV pool "
+         "in HBM via memory_space=ANY; the double-buffered block "
+         "scratch is the VMEM cost)")
+def _dslint_paged_verify_case():
+    import numpy as np
+
+    K = 4
+    bs, kp, vp, tables, slot, pos, _q = _dslint_paged_setup(128)
+    S = tables.shape[0]
+    rng = np.random.default_rng(7)
+    qv = jnp.asarray(rng.standard_normal((S * K, 8, 128)).astype(np.float32),
+                     jnp.bfloat16)
+    vslot = jnp.repeat(jnp.arange(S, dtype=jnp.int32), K)
+    vpos = (pos[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(-1)
+    paged_verify_attention(qv, kp, vp, tables, vslot, vpos,
+                           block_size=bs, k_tokens=K, interpret=True)
 
 
 @pallas_kernel_case("paged_prefill",

@@ -96,7 +96,7 @@ def _rms_norm(x, scale, eps):
 
 def _paged_attention(q, k_pool, v_pool, batch, block_size,
                      use_kernel=None, window=None, prefill_tile=None,
-                     decode_mode=False, force_dense=None):
+                     decode_mode=False, force_dense=None, verify_k=None):
     """Paged attention over the blocked KV pool.
 
     q: [T, H, D]; k_pool/v_pool: [num_blocks*bs, Hkv, D].
@@ -131,10 +131,23 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
     if use_kernel and force_dense is None:
         from deepspeed_tpu.inference.v2.kernels import (
             paged_attention, paged_attention_usable,
-            paged_decode_attention, paged_prefill_attention)
+            paged_decode_attention, paged_prefill_attention,
+            paged_verify_attention)
 
         if paged_attention_usable(q, k_pool, block_size):
             w = int(window) if window is not None else None
+            if verify_k and q.shape[-1] % 128 == 0:
+                # speculative multi-token verify: K query rows per slot
+                # share one O(live-context) block walk (the fused
+                # multi-query variant of the decode kernel); lane-dim
+                # constraint matches the decode DMA kernel's.  Smaller
+                # head dims fall through to the generic grid kernel,
+                # which handles verify-shaped metadata unchanged.
+                return paged_verify_attention(
+                    q, k_pool, v_pool, batch["block_tables"],
+                    batch["token_slot"], batch["token_pos"],
+                    block_size=block_size, k_tokens=int(verify_k),
+                    window=w)
             if decode_mode:
                 # the manual-DMA kernel copies [bs, Hkv, D] pool blocks,
                 # whose lane dim D must be 128-aligned, and it wins when
@@ -268,7 +281,8 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
 
 def ragged_attention_block(lp_attn, xa, layer_cache, batch, block_size, cfg,
                            h, hkv, d, cos, sin, ax=None,
-                           prefill_tile=None, decode_mode=False):
+                           prefill_tile=None, decode_mode=False,
+                           verify_k=None):
     """Shared per-layer attention body (RaggedLlama + RaggedMixtral):
     qkv proj → rotary → paged-KV scatter → blocked-flash → o_proj
     (+ row-parallel psum under TP). ``h``/``hkv`` are LOCAL head counts.
@@ -286,7 +300,7 @@ def ragged_attention_block(lp_attn, xa, layer_cache, batch, block_size, cfg,
     out = _paged_attention(q, k_pool, v_pool, batch, block_size,
                            window=cfg.sliding_window,
                            prefill_tile=prefill_tile,
-                           decode_mode=decode_mode)
+                           decode_mode=decode_mode, verify_k=verify_k)
     out = qmm(out.reshape(-1, h * d), lp_attn["o_proj"]["kernel"], dt)
     if ax is not None:
         out = jax.lax.psum(out, ax)                   # row-parallel attn-out
@@ -341,23 +355,30 @@ class RaggedLlama:
 
     def __call__(self, params: Dict[str, Any], kv_cache: Dict[str, Any],
                  batch: Dict[str, jax.Array], prefill_tile=None,
-                 decode=False):
+                 decode=False, verify_k=None):
         """Run one ragged forward.
 
         Returns ``(logits [S, vocab], new_kv_cache)`` where row ``s`` holds
         the logits of slot ``s``'s LAST scheduled token. ``prefill_tile``
         (static) marks a tile-aligned batch -> tiled prefill kernel;
         ``decode`` (static) marks a one-token-per-slot batch with
-        ``token_slot == arange`` -> decode-optimised attention path.
+        ``token_slot == arange`` -> decode-optimised attention path;
+        ``verify_k`` (static) marks a speculative verify batch — K
+        consecutive-position tokens per slot, rows slot-major — routed
+        to the fused multi-query verify kernel on TPU (the batch's
+        ``logits_idx`` selects EVERY row, so the caller gets all K
+        candidate logits per slot).
         """
         if self.tp == 1:
             return self._forward(params, kv_cache, batch, ax=None,
-                                 prefill_tile=prefill_tile, decode=decode)
+                                 prefill_tile=prefill_tile, decode=decode,
+                                 verify_k=verify_k)
         param_specs = ragged_param_specs(params)
         cache_specs = jax.tree.map(lambda _x: KV_SPEC, kv_cache)
         batch_specs = jax.tree.map(lambda _x: P(), batch)
         fwd = functools.partial(self._forward, ax=self.tp_axis,
-                                prefill_tile=prefill_tile, decode=decode)
+                                prefill_tile=prefill_tile, decode=decode,
+                                verify_k=verify_k)
         return jax.shard_map(
             fwd, mesh=self.mesh,
             in_specs=(param_specs, cache_specs, batch_specs),
@@ -379,7 +400,7 @@ class RaggedLlama:
         return jax.lax.psum(x, ax)
 
     def _forward(self, params, kv_cache, batch, *, ax, prefill_tile=None,
-                 decode=False):
+                 decode=False, verify_k=None):
         cfg = self.config
         m = params["model"]
         dt = cfg.dtype
@@ -401,7 +422,7 @@ class RaggedLlama:
             out, new_cache[f"layer_{i}"] = ragged_attention_block(
                 lp["self_attn"], xa, kv_cache[f"layer_{i}"], batch,
                 self.block_size, cfg, h, hkv, d, cos, sin, ax=ax,
-                decode_mode=decode)
+                decode_mode=decode, verify_k=verify_k)
             x = x + out
             xm = _rms_norm(x, lp["post_attention_layernorm"]["scale"],
                            cfg.rms_norm_eps)

@@ -163,6 +163,41 @@ class RadixPrefixCache:
         LRU state (the router's placement probe)."""
         return len(self.match_blocks(tokens, touch=False)) * self.block_size
 
+    def lookup_continuation(self, tokens: Sequence[int],
+                            k: int) -> List[int]:
+        """Up to ``k`` cached token values that FOLLOW ``tokens`` along
+        the tree — the speculative drafter's probe: if a previous
+        request already generated through this exact history, the
+        deeper edge labels predict the continuation verbatim.
+
+        ``tokens`` must lie entirely on a cached path (full blocks plus
+        a partial tail prefix-matching one child's edge label);
+        otherwise returns ``[]``.  Never touches LRU stamps — a draft
+        probe is not a use.
+        """
+        if k <= 0:
+            return []
+        bs = self.block_size
+        toks = [int(t) for t in tokens]
+        path = self._walk(toks)        # _walk never touches LRU stamps
+        if len(path) < len(toks) // bs:
+            return []                  # history leaves the cached paths
+        node = path[-1] if path else self._root
+        tail = tuple(toks[(len(toks) // bs) * bs:])
+        out: List[int] = []
+        while len(out) < k:
+            nxt = None
+            for key, child in node.children.items():
+                if key[:len(tail)] == tail:
+                    nxt = (key[len(tail):], child)
+                    break
+            if nxt is None:
+                break
+            label_rest, node = nxt
+            out.extend(label_rest)
+            tail = ()
+        return out[:k]
+
     # ------------------------------------------------------------------ #
     # Insert
     # ------------------------------------------------------------------ #

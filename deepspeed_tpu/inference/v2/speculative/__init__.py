@@ -1,0 +1,43 @@
+"""Speculative decoding subsystem (ROADMAP item 1).
+
+Three layers, one mechanism:
+
+* **draft** (:mod:`drafter`) — self-speculative n-gram / prompt-lookup
+  and radix-prefix-cache drafters (no extra model), plus a pluggable
+  small-model drafter interface;
+* **verify** — ``InferenceEngineV2.verify_step(uids, draft_tokens[K])``
+  scores K candidate positions per sequence in ONE weight pass, backed
+  on TPU by the fused multi-query variant of the paged blocked-flash
+  decode kernel (``paged_verify_attention``); acceptance
+  (:func:`accept_drafts`) reuses the (seed, uid, position)-keyed
+  sampler so greedy AND stochastic output stays identical to
+  non-speculative decode;
+* **schedule** — ``ContinuousBatchScheduler(speculative=
+  SpeculativeConfig(...))`` runs verify passes on pure-decode ticks,
+  emits the variable accepted-token count per tick, and
+  ``engine.commit_verified`` rolls rejected lookahead KV blocks back so
+  the allocator ends exactly where a never-drafted run would.
+
+Why this attacks BOTH ends of the model-size axis: 7B int8 decode sits
+at 0.954 of its HBM roofline — the only speedup left is more tokens per
+weight stream, which accepted drafts deliver; 125M decode is
+dispatch-bound — one verify pass amortises the per-step dispatch over K
+positions.
+"""
+
+from deepspeed_tpu.inference.v2.speculative.drafter import (
+    Drafter,
+    NgramDrafter,
+    PrefixCacheDrafter,
+    SmallModelDrafter,
+    make_self_drafter,
+)
+from deepspeed_tpu.inference.v2.speculative.verify import (
+    SpeculativeConfig,
+    SpeculativeStats,
+    accept_drafts,
+)
+
+__all__ = ["Drafter", "NgramDrafter", "PrefixCacheDrafter",
+           "SmallModelDrafter", "SpeculativeConfig", "SpeculativeStats",
+           "accept_drafts", "make_self_drafter"]

@@ -14,6 +14,14 @@ Typical use::
     print(req.generated, req.ttft)
 """
 
+# speculative decoding lives with the engine (inference/v2/speculative)
+# but is configured at the scheduler — re-exported here for convenience
+from deepspeed_tpu.inference.v2.speculative import (NgramDrafter,
+                                                    PrefixCacheDrafter,
+                                                    SmallModelDrafter,
+                                                    SpeculativeConfig,
+                                                    SpeculativeStats,
+                                                    make_self_drafter)
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import (Request, RequestSnapshot,
                                            RequestState, SamplingParams)
@@ -27,8 +35,10 @@ from deepspeed_tpu.serving.scheduler import (ContinuousBatchScheduler,
                                              TickDeadlineError)
 
 __all__ = ["AdmissionRejectedError", "CacheAwareRouter",
-           "ContinuousBatchScheduler", "PriorityClass", "QueueFullError",
-           "QuotaExceededError", "Replica", "Request", "RequestSnapshot",
-           "RequestState", "SamplingParams", "ServingMetrics",
-           "TenantQuota", "TickDeadlineError", "sample_batch",
+           "ContinuousBatchScheduler", "NgramDrafter", "PrefixCacheDrafter",
+           "PriorityClass", "QueueFullError", "QuotaExceededError",
+           "Replica", "Request", "RequestSnapshot", "RequestState",
+           "SamplingParams", "ServingMetrics", "SmallModelDrafter",
+           "SpeculativeConfig", "SpeculativeStats", "TenantQuota",
+           "TickDeadlineError", "make_self_drafter", "sample_batch",
            "sample_one"]

@@ -56,6 +56,22 @@ class ServingMetrics:
         self.queue_wait_s: List[float] = []
         #: (emit time, 1) per goodput-counted token, for the rolling rate
         self._token_times: Deque[float] = deque()
+        # -- decode-tick accounting ------------------------------------ #
+        # TPOT derived here divides by tokens DELIVERED per tick, not by
+        # tick count: the moment multi-token speculative acceptance
+        # lands, one decode tick emits several tokens and the old
+        # one-token-per-tick assumption overstates per-token latency by
+        # the acceptance factor.  The raw per-tick latency list is kept
+        # as its own derived series (p50/p95_decode_tick_s).
+        self.decode_ticks = 0
+        self.decode_tick_tokens = 0
+        self.decode_tick_requests = 0
+        self._decode_tick_time_s = 0.0
+        #: request-seconds: Σ elapsed * batched-requests — dividing by
+        #: tokens delivered gives the mean inter-token latency a REQUEST
+        #: experiences (batch-independent, acceptance-aware)
+        self._decode_req_seconds = 0.0
+        self.decode_tick_s: List[float] = []
 
     # ------------------------------------------------------------------ #
     # Lifecycle hooks
@@ -75,6 +91,28 @@ class ServingMetrics:
         """The request left this scheduler ALIVE (drain-handoff or
         prefill→decode migration) — neither finished nor failed here."""
         self.handoffs += 1
+
+    def record_decode_tick(self, tokens: int, requests: int,
+                           elapsed_s: float) -> None:
+        """One pure-decode scheduler tick batched ``requests`` requests
+        and delivered ``tokens`` tokens in ``elapsed_s`` seconds.
+        ``tokens == requests`` on a plain decode tick; speculative
+        acceptance delivers more."""
+        self.decode_ticks += 1
+        self.decode_tick_tokens += int(tokens)
+        self.decode_tick_requests += int(requests)
+        self._decode_tick_time_s += float(elapsed_s)
+        self._decode_req_seconds += float(elapsed_s) * int(requests)
+        self.decode_tick_s.append(float(elapsed_s))
+
+    def tpot_delivered_s(self) -> float:
+        """Per-request inter-token latency, dividing by tokens DELIVERED
+        per tick — the TPOT that stays truthful under multi-token
+        (speculative) acceptance.  Request-seconds over tokens: on plain
+        one-token-per-request ticks this reduces to the mean tick time
+        (the old TPOT); under acceptance it shrinks by the per-request
+        tokens-per-tick factor, exactly as a client experiences."""
+        return self._decode_req_seconds / max(self.decode_tick_tokens, 1)
 
     def record_finish(self, req: Request) -> None:
         now = time.monotonic()
@@ -143,9 +181,22 @@ class ServingMetrics:
             "goodput_tokens_per_s": self.goodput_tokens_per_s(),
             "overall_tokens_per_s": self.overall_tokens_per_s(),
         }
+        if self.decode_ticks:
+            out["decode_ticks"] = float(self.decode_ticks)
+            out["decode_tokens_delivered"] = float(self.decode_tick_tokens)
+            out["tokens_per_decode_tick"] = (self.decode_tick_tokens
+                                             / self.decode_ticks)
+            # per-request acceptance factor: 1.0 on plain decode, >1
+            # when speculative acceptance delivers token bursts
+            out["tokens_per_request_tick"] = (
+                self.decode_tick_tokens
+                / max(self.decode_tick_requests, 1))
+            out["tpot_delivered_s"] = self.tpot_delivered_s()
         for name, vals in (("ttft_s", self.ttft_s),
                            ("tpot_s", self.tpot_s),
-                           ("queue_wait_s", self.queue_wait_s)):
+                           ("queue_wait_s", self.queue_wait_s),
+                           # old one-token-per-tick view, as a ticks series
+                           ("decode_tick_s", self.decode_tick_s)):
             if vals:
                 out[f"p50_{name}"] = _pct(vals, 50)
                 out[f"p95_{name}"] = _pct(vals, 95)

@@ -33,6 +33,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.inference.v2.speculative import (SpeculativeConfig,
+                                                    SpeculativeStats,
+                                                    accept_drafts)
 from deepspeed_tpu.resilience import chaos
 from deepspeed_tpu.resilience.heartbeat import Heartbeat
 from deepspeed_tpu.serving.metrics import ServingMetrics
@@ -75,8 +78,25 @@ class ContinuousBatchScheduler:
                  export_every: int = 0,
                  max_queue: Optional[int] = None,
                  fast_decode: bool = True,
-                 tick_deadline_s: Optional[float] = None):
+                 tick_deadline_s: Optional[float] = None,
+                 speculative: Optional[SpeculativeConfig] = None):
         self.engine = engine
+        #: speculative decoding (ROADMAP item 1): pure-decode ticks run a
+        #: drafter + one multi-token verify_step instead of decode_step,
+        #: emitting 1..draft_k+1 tokens per weight pass; a tick with no
+        #: drafts (or no KV/context room for the lookahead) falls back to
+        #: the plain fast decode tick
+        if speculative is not None:
+            if not hasattr(engine, "verify_step"):
+                raise ValueError(
+                    "speculative decoding needs an engine with "
+                    "verify_step/commit_verified (InferenceEngineV2)")
+            if not fast_decode:
+                raise ValueError(
+                    "speculative decoding runs on the fast decode tick — "
+                    "fast_decode=False would silently never speculate")
+        self.speculative = speculative
+        self.spec_stats = SpeculativeStats()
         #: pure-decode ticks go through ``engine.decode_step`` — block
         #: tables/positions stay device-resident across ticks and the
         #: only host transfer is the sampled-token fetch, instead of a
@@ -263,14 +283,26 @@ class ContinuousBatchScheduler:
         # that stalls on anything (engine, allocator, GIL) should trip
         t0 = time.monotonic()
         chaos.fire("tick_stall")
-        if self.fast_decode and all(r.state is RequestState.DECODE
-                                    for r in packed):
-            emitted = self._fast_decode_tick(uids, chunks, packed)
+        decode_tick = all(r.state is RequestState.DECODE for r in packed)
+        if self.fast_decode and decode_tick:
+            emitted = None
+            if self.speculative is not None:
+                emitted = self._speculative_decode_tick(uids, chunks,
+                                                        packed)
+            if emitted is None:
+                if self.speculative is not None:
+                    self.spec_stats.fallback_ticks += 1
+                emitted = self._fast_decode_tick(uids, chunks, packed)
         else:
             logits = self.engine.put(uids, chunks, sync=True)
             for req, chunk in zip(packed, chunks):
                 req.fed += len(chunk)
             emitted = self._sample_and_advance(packed, logits)
+        if decode_tick:
+            # per-tick TPOT accounting divides by tokens DELIVERED (a
+            # speculative tick can emit several per request)
+            self.metrics.record_decode_tick(len(emitted), len(packed),
+                                            time.monotonic() - t0)
         if self.tick_deadline_s is not None:
             elapsed = time.monotonic() - t0
             if elapsed > self.tick_deadline_s:
@@ -310,6 +342,106 @@ class ContinuousBatchScheduler:
                                   [len(r.generated) for r in packed],
                                   [r.uid for r in packed])
         return self._advance_emitted(packed, tokens_out.tolist())
+
+    # -- speculative decode -------------------------------------------- #
+    def _speculative_decode_tick(self, uids, chunks, packed
+                                 ) -> Optional[List[Tuple[Request, int]]]:
+        """Draft + one multi-token verify pass over the decode batch.
+
+        Returns the emitted ``(request, token)`` pairs, or None when
+        speculation opted out this tick (no drafts anywhere, or no room
+        for the K-token lookahead) — the caller then runs the plain fast
+        decode tick.  Output is token-for-token what sequential decode
+        would emit: acceptance reuses the (seed, uid, position)-keyed
+        sampler against each candidate slot's logits, and a stop
+        token / length limit inside an accepted run truncates exactly
+        where the sequential run would have stopped.
+        """
+        spec = self.speculative
+        gamma = spec.draft_k
+        drafts: List[List[int]] = []
+        for r in packed:
+            # never draft past the generation budget: at most
+            # remaining - 1 drafts can be emitted alongside the bonus
+            remaining = r.sampling.max_new_tokens - len(r.generated)
+            drafts.append(list(
+                spec.drafter.draft(r.history, min(gamma, remaining - 1))
+            )[:gamma])
+        if not any(drafts):
+            return None
+        K = gamma + 1
+        if not self.engine.can_schedule(uids, [K] * len(uids)):
+            return None                  # lookahead KV/context won't fit
+        import jax
+
+        feed = [[r.history[-1]] + d + [0] * (gamma - len(d))
+                for r, d in zip(packed, drafts)]
+        spans = [len(d) + 1 for d in drafts]
+        if all(r.sampling.greedy for r in packed):
+            # all-greedy: the step program argmax'd every candidate slot
+            # on device — fetch K ints per sequence, never the [n, K,
+            # vocab] logits (the same asymmetry the plain greedy fast
+            # tick exploits via decode_step(greedy=True))
+            _, nxt = self.engine.verify_step(uids, feed, greedy=True)
+            toks = np.asarray(jax.device_get(nxt))[:len(uids)]
+            cand = np.concatenate(
+                [toks[i, :m] for i, m in enumerate(spans)])
+        else:
+            # device logits [max_seqs, K, vocab]; the stochastic sampler
+            # needs them on host — one fetch per verify pass (vs one per
+            # token unspeculated).  One vectorised sampler call over
+            # every candidate slot: slot k of request i draws at
+            # generation position len(generated)+k — the exact key
+            # sequential decode would use
+            rows = np.asarray(jax.device_get(
+                self.engine.verify_step(uids, feed)),
+                np.float32)[:len(uids)]
+            flat_rows, flat_params, flat_pos, flat_uids = [], [], [], []
+            for i, (r, d) in enumerate(zip(packed, drafts)):
+                m = spans[i]
+                flat_rows.append(rows[i, :m])
+                flat_params.extend([r.sampling] * m)
+                flat_pos.extend(len(r.generated) + k for k in range(m))
+                flat_uids.extend([r.uid] * m)
+            cand = sample_batch(np.concatenate(flat_rows, axis=0),
+                                flat_params, flat_pos, flat_uids)
+        emitted: List[Tuple[Request, int]] = []
+        now = time.monotonic()
+        self.spec_stats.ticks += 1
+        off = 0
+        for i, (req, d) in enumerate(zip(packed, drafts)):
+            out, acc = accept_drafts(cand[off:off + spans[i]], d)
+            off += spans[i]
+            self.spec_stats.drafted += len(d)
+            self.spec_stats.accepted += acc
+            # commit the accepted feed prefix (input + accepted drafts);
+            # the engine trims rejected lookahead blocks back
+            self.engine.commit_verified(req.uid, feed[i][:1 + acc])
+            req.fed += 1 + acc
+            got = self._emit_many(req, out, now)
+            # count what was DELIVERED, not what was accepted — a stop
+            # token mid-burst truncates delivery exactly where
+            # sequential decode would have stopped
+            self.spec_stats.emitted += len(got)
+            emitted.extend(got)
+        return emitted
+
+    def _emit_many(self, req: Request, tokens: Sequence[int],
+                   now: float) -> List[Tuple[Request, int]]:
+        """Emit a verify pass's accepted burst, stopping exactly where
+        sequential decode would (stop token / max_new_tokens /
+        max_context truncate the burst)."""
+        emitted: List[Tuple[Request, int]] = []
+        for tok in tokens:
+            req.emit(int(tok), now)
+            emitted.append((req, int(tok)))
+            reason = req.should_stop()
+            if reason is None and len(req.history) >= self.max_context:
+                reason = "length"
+            if reason is not None:
+                self._finish(req, reason)
+                break
+        return emitted
 
     # -- packing ------------------------------------------------------- #
     def _pack_decodes(self, uids, chunks, packed) -> None:
@@ -558,6 +690,9 @@ class ContinuousBatchScheduler:
     def _export_metrics(self) -> None:
         """serving/* scalars plus prefix-cache and fast-tick telemetry."""
         extra = [("serving/fast_decode_ticks", float(self.fast_ticks))]
+        if self.speculative is not None:
+            extra.extend((f"serving/spec_{k}", v)
+                         for k, v in self.spec_stats.as_dict().items())
         pc = getattr(self.engine.state_manager, "prefix_cache", None) \
             if hasattr(self.engine, "state_manager") else None
         if pc is not None:

@@ -249,9 +249,10 @@ def test_ragged_engine_with_kernel_path():
     orig = rl._paged_attention
 
     def forced(q, k_pool, v_pool, batch, block_size, use_kernel=None,
-               window=None, prefill_tile=None, decode_mode=False):
+               **kw):
+        kw.pop("decode_mode", None)
         return orig(q, k_pool, v_pool, batch, block_size, use_kernel=True,
-                    window=window, prefill_tile=prefill_tile)
+                    **kw)
 
     params = _params()
     engine_ref = _v2_engine(params)

@@ -831,3 +831,10 @@ def test_prefix_router_smoke_tool():
     snap = _load_smoke().run_prefix_router_smoke()
     assert snap["router_smoke"] == "ok"
     assert snap["router_cache_hits"] >= 6
+
+
+def test_speculative_smoke_tool():
+    snap = _load_smoke().run_speculative_smoke()
+    assert snap["speculative_smoke"] == "ok"
+    assert snap["spec_accept_rate"] > 0
+    assert snap["spec_tokens_per_pass"] >= 1

@@ -177,6 +177,27 @@ def run_selftest(tol: float = 3e-2) -> dict:
                                token_pos, block_size=bs, interpret=False),
         want2))
 
+    # speculative multi-token verify: K=4 query rows per slot sharing
+    # the decode kernel's block walk (engine verify_step's TPU path;
+    # same D % 128 == 0 DMA constraint as paged_decode_dma)
+    from deepspeed_tpu.inference.v2.kernels import paged_verify_attention
+
+    Kv = 4
+    qv = jax.random.normal(jax.random.fold_in(key, 10),
+                           (S * Kv, 8, 128), jnp.bfloat16)
+    vslot = jnp.repeat(jnp.arange(S, dtype=jnp.int32), Kv)
+    vpos = (token_pos[:, None]
+            + jnp.arange(Kv, dtype=jnp.int32)[None, :]).reshape(-1)
+    vbatch = {"block_tables": tables, "token_slot": vslot,
+              "token_pos": vpos}
+    wantv = _paged_attention(qv, k_pool2, v_pool2, vbatch, bs,
+                             use_kernel=False)
+    guarded("paged_verify_multiquery", lambda: record(
+        "paged_verify_multiquery",
+        paged_verify_attention(qv, k_pool2, v_pool2, tables, vslot, vpos,
+                               block_size=bs, k_tokens=Kv,
+                               interpret=False), wantv))
+
     # prefill: tile-aligned tokens for slot 0, at the ENGINE's shipped
     # 125M serving geometry (6 q heads / 2 kv heads — the exact kernel
     # instantiation bench_serving.py runs)
