@@ -165,9 +165,44 @@ def _enable_compile_cache():
         pass
 
 
+def _cli_trace_out():
+    """``--trace OUT``: bracket the bench's stages in host spans (and
+    turn on jax.profiler TraceAnnotations around engine dispatch) and
+    write a Chrome/Perfetto timeline to OUT next to the JSON record —
+    the merged host↔device view ROADMAP item 2's remat/fusion work
+    profiles against when a ``jax.profiler`` capture runs alongside."""
+    for i, a in enumerate(sys.argv):
+        if a.startswith("--trace="):
+            return a.split("=", 1)[1]
+        if a == "--trace" and i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return None
+
+
 def main():
     t_start = time.perf_counter()
     _enable_compile_cache()
+    trace_out = _cli_trace_out()
+    tracer = None
+    if trace_out is not None:
+        from deepspeed_tpu.observability import (Tracer,
+                                                 enable_device_annotations)
+
+        enable_device_annotations(True)
+        tracer = Tracer(capacity=65536, tid="bench")
+
+    def _stage(name):
+        import contextlib
+
+        if tracer is None:
+            return contextlib.nullcontext()
+        return tracer.span(name, trace_id=_bench_trace_id)
+
+    _bench_trace_id = None
+    if tracer is not None:
+        from deepspeed_tpu.observability import mint_trace_id
+
+        _bench_trace_id = mint_trace_id()
     devs, backend_err = _probe_backend()
     if devs is None:
         print(json.dumps({"metric": "train_tokens_per_sec_per_chip_gpt125m",
@@ -187,7 +222,8 @@ def main():
         try:
             from bench_serving import measure_7b
 
-            serving_7b = measure_7b()
+            with _stage("bench/7b_serving"):
+                serving_7b = measure_7b()
         except Exception as e:  # noqa: BLE001
             serving_7b = {"error": f"{type(e).__name__}: {e}"}
     else:
@@ -211,9 +247,10 @@ def main():
     import os
 
     headline_layout = os.environ.get("DS_ATTENTION_LAYOUT", "bshd")
-    tok_s, mfu, loss, step_ms, n_params, n_dev = _measure(
-        heads=HEADLINE_HEADS, micro_batch=HEADLINE_MB, seq=seq,
-        attention_layout=headline_layout)
+    with _stage("bench/headline_train"):
+        tok_s, mfu, loss, step_ms, n_params, n_dev = _measure(
+            heads=HEADLINE_HEADS, micro_batch=HEADLINE_MB, seq=seq,
+            attention_layout=headline_layout)
 
     # on-chip Pallas kernel selftest (every kernel vs its jnp reference,
     # compiled — not interpret mode), time-permitting
@@ -234,8 +271,9 @@ def main():
 
     tpu_geom = None
     if elapsed() < 430:
-        tok_s2, mfu2, _loss2, step_ms2, _, _ = _measure(
-            heads=TPU_HEADS, micro_batch=TPU_MB, seq=seq)
+        with _stage("bench/tpu_geometry"):
+            tok_s2, mfu2, _loss2, step_ms2, _, _ = _measure(
+                heads=TPU_HEADS, micro_batch=TPU_MB, seq=seq)
         tpu_geom = {
             "heads": TPU_HEADS, "head_dim": 768 // TPU_HEADS,
             "micro_batch": TPU_MB,
@@ -272,6 +310,11 @@ def main():
         else:
             folded_geom = {"note": "skipped: bench time budget"}
 
+    if tracer is not None:
+        from deepspeed_tpu.observability import write_chrome_trace
+
+        write_chrome_trace(trace_out, tracer.export_events())
+        print(f"# trace written to {trace_out}", file=sys.stderr)
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip_gpt125m",
         "value": round(tok_s, 1),

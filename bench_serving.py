@@ -365,10 +365,52 @@ def measure_7b(clients: int = 8, prompt_len: int = 256,
     }
 
 
+def _tracer_overhead(engine, prompts, sampling, clients: int,
+                     trace_out=None) -> dict:
+    """A/B the decode-tick cost of host-side tracing: the same decode-
+    dominated workload through an untraced scheduler, then a traced one
+    (ring-buffer spans for every tick/phase/request transition), over
+    the SAME warm engine.  Median-of-ticks keeps one scheduler's noise
+    spike from deciding the verdict.  With ``trace_out`` the traced
+    arm's timeline is written as Chrome/Perfetto trace-event JSON."""
+    from deepspeed_tpu.observability import Tracer, write_chrome_trace
+    from deepspeed_tpu.serving import ContinuousBatchScheduler
+
+    def arm(tracer):
+        sched = ContinuousBatchScheduler(engine, tracer=tracer)
+        for i in range(clients):
+            sched.submit(prompts[i], sampling=sampling)
+        sched.run_until_idle()
+        return list(sched.metrics.decode_tick_s)
+
+    # interleaved U/T/U/T arms: host noise (CPU contention, thermal
+    # drift) hits both modes alike instead of whichever ran first
+    tracer = Tracer(capacity=65536, tid="bench")
+    untraced_ticks, traced_ticks = [], []
+    for _round in range(2):
+        untraced_ticks.extend(arm(None))
+        traced_ticks.extend(arm(tracer))
+    untraced_s = float(np.median(np.asarray(untraced_ticks, np.float64)))
+    traced_s = float(np.median(np.asarray(traced_ticks, np.float64)))
+    events = tracer.export_events()
+    out = {
+        "decode_tick_ms_untraced": round(untraced_s * 1e3, 4),
+        "decode_tick_ms_traced": round(traced_s * 1e3, 4),
+        "tracer_overhead_pct": round(
+            (traced_s / max(untraced_s, 1e-12) - 1.0) * 100.0, 3),
+        "trace_events": len(events),
+    }
+    if trace_out:
+        write_chrome_trace(trace_out, events)
+        out["trace_path"] = trace_out
+    return out
+
+
 def measure_scheduler(n_requests: int = 32, rate_rps: float = 16.0,
                       prompt_len: int = 192, gen_tokens: int = 48,
                       clients: int = 8, block_size: int = 128,
-                      kv_fraction: float = 0.7, seed: int = 0):
+                      kv_fraction: float = 0.7, seed: int = 0,
+                      trace_out=None):
     """Scheduler-mode serving benchmark: Poisson arrivals driven through
     the ``deepspeed_tpu.serving`` continuous-batching scheduler (Dynamic
     SplitFuse packing + KV-pressure preemption), instead of the
@@ -442,6 +484,11 @@ def measure_scheduler(n_requests: int = 32, rate_rps: float = 16.0,
         f"{len(finished)}/{n_requests} finished ({snap})"
     goodput = snap["total_tokens"] / wall
 
+    # tracer-overhead A/B over the same warm engine (ISSUE 12: tracing
+    # must stay <2% of decode-tick wall; PERFLOG records the number)
+    overhead = _tracer_overhead(engine, prompts, sampling, clients,
+                                trace_out=trace_out)
+
     # roofline context: batched decode at full concurrency streams the
     # weights once per step (same denominator as the steady-state bench)
     n_params = sum(int(np.prod(l.shape))
@@ -471,6 +518,7 @@ def measure_scheduler(n_requests: int = 32, rate_rps: float = 16.0,
             "kv_fraction_of_worst_case": kv_fraction,
             "wall_s": round(wall, 2),
             "platform": jax.devices()[0].platform,
+            **overhead,
         },
     }
 
@@ -1001,6 +1049,10 @@ if __name__ == "__main__":
         raise SystemExit("bench_serving: --disaggregate P:D requires "
                          "--fleet N")
     _speculative = "--speculative" in sys.argv
+    _trace_out = _cli_str("--trace", None)
+    if _trace_out is not None and "--scheduler" not in sys.argv:
+        raise SystemExit("bench_serving: --trace OUT requires "
+                         "--scheduler (the traced decode A/B mode)")
     _draft_k_given = any(a == "--draft-k" or a.startswith("--draft-k=")
                          for a in sys.argv)
     _draft_k = int(_cli_float("--draft-k", 4))
@@ -1024,7 +1076,7 @@ if __name__ == "__main__":
         if "--7b" in sys.argv:
             print(json.dumps(measure_7b()))
         elif "--scheduler" in sys.argv:
-            print(json.dumps(measure_scheduler()))
+            print(json.dumps(measure_scheduler(trace_out=_trace_out)))
         elif _fleet:
             try:
                 _n_replicas = int(_cli_float("--fleet", 2))

@@ -2,13 +2,16 @@
 stack (the role nvcc's build-time checking plays for the reference's
 CUDA tree; see ``tools/dslint.py`` for the CLI).
 
-Three passes:
+Four passes:
 
-* :mod:`.pallas_lint` — kernel contract checker over every
+* :mod:`.pallas_lint`  — kernel contract checker over every
   ``pallas_call`` site (tiling, index-map bounds, output coverage,
   VMEM budget) via the :mod:`.registry` of representative shapes;
-* :mod:`.jit_lint`    — AST lint for jit-unsafe and host-sync patterns;
-* :mod:`.trace_guard` — runtime guard proving warmed-up regions are
+* :mod:`.jit_lint`     — AST lint for jit-unsafe and host-sync patterns;
+* :mod:`.metrics_lint` — metric-name cross-check: every metric-shaped
+  string literal must match a name declared in the unified
+  :class:`~deepspeed_tpu.observability.registry.MetricsRegistry`;
+* :mod:`.trace_guard`  — runtime guard proving warmed-up regions are
   recompile- and transfer-free.
 """
 

@@ -1,0 +1,150 @@
+"""Metric-name registry lint ("dslint" pass 3).
+
+Cross-checks every metric-shaped string literal in the source against
+the names declared in the unified
+:class:`~deepspeed_tpu.observability.registry.MetricsRegistry`.  A
+typo'd namespace (``serving/prefx_hits``, ``fleet/spec_ticks`` spelled
+``fleet/spec_tick``) silently becomes a brand-new series today — the
+writers happily create the file/chart and every consumer reads zeros
+from the real name.  This pass catches it at lint time.
+
+What counts as a metric literal: a plain string constant, or an
+f-string's leading literal, matching ``^(serving|fleet|resilience)/``.
+Matching against the registry:
+
+* an exact literal must equal a declared name or match a declared
+  trailing-``*`` family;
+* an f-string prefix (e.g. ``serving/spec_`` from
+  ``f"serving/spec_{k}"``) must be compatible with at least one
+  declaration — some exact name starts with it, or some family prefix
+  overlaps it;
+* a bare-namespace f-string (``f"serving/{k}"`` — the generic
+  namespacing loops) is indeterminate and skipped.
+
+Declarations load by importing the metrics modules (serving / fleet /
+resilience), which declare into the default registry at import time —
+no engine, no jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Sequence
+
+from deepspeed_tpu.analysis.common import Finding, relpath
+
+NAMESPACES = ("serving/", "fleet/", "resilience/")
+RULE = "metric-name"
+
+
+def declared_specs():
+    """The default registry's declarations, with every declaring metrics
+    module imported first (import is what declares)."""
+    import deepspeed_tpu.fleet.metrics  # noqa: F401 — declares fleet/*
+    import deepspeed_tpu.resilience.metrics  # noqa: F401
+    import deepspeed_tpu.serving.metrics  # noqa: F401
+    from deepspeed_tpu.observability.registry import MetricsRegistry
+
+    return MetricsRegistry.default().declared()
+
+
+def _matches_exact(name: str, specs) -> bool:
+    return any(s.matches(name) for s in specs)
+
+
+def _matches_prefix(prefix: str, specs) -> bool:
+    """An f-string's literal head is compatible when SOME declaration
+    could produce a name starting with it."""
+    for s in specs:
+        if s.is_pattern:
+            if prefix.startswith(s.prefix) or s.prefix.startswith(prefix):
+                return True
+        elif s.name.startswith(prefix):
+            return True
+    return False
+
+
+def _metric_head(s: str) -> Optional[str]:
+    for ns in NAMESPACES:
+        if s.startswith(ns):
+            return ns
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, specs):
+        self.path = path
+        self.specs = specs
+        self.findings: List[Finding] = []
+        self._func = ""
+
+    def visit_FunctionDef(self, node):
+        prev, self._func = self._func, node.name
+        self.generic_visit(node)
+        self._func = prev
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _flag(self, node, literal: str, kind: str) -> None:
+        self.findings.append(Finding(
+            rule=RULE, path=relpath(self.path), line=node.lineno,
+            func=self._func,
+            message=f"{kind} {literal!r} matches no declared metric",
+            hint="declare it in the owning metrics module's _declare() "
+                 "(observability.registry) or fix the typo",
+            severity="error"))
+
+    def visit_Constant(self, node):
+        v = node.value
+        # prose (docstrings mentioning "serving/*...") and the bare
+        # namespace constant are not metric names
+        if isinstance(v, str) and _metric_head(v) is not None \
+                and v not in NAMESPACES \
+                and not any(c.isspace() for c in v) \
+                and not _matches_exact(v, self.specs):
+            self._flag(node, v, "metric name")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):
+        # leading literal of the f-string only: f"serving/spec_{k}..."
+        head = ""
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value,
+                                                             str):
+                head += part.value
+            else:
+                break
+        ns = _metric_head(head)
+        if ns is not None and head != ns \
+                and not _matches_prefix(head, self.specs):
+            self._flag(node, head + "{...}", "metric name prefix")
+        # no generic_visit: the inner constants were judged as the
+        # joined prefix; visiting them alone would re-flag fragments
+
+
+def _py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, _dirs, files in os.walk(p):
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def run_metrics_lint(paths: Sequence[str],
+                     specs=None) -> List[Finding]:
+    specs = declared_specs() if specs is None else specs
+    findings: List[Finding] = []
+    for path in _py_files(paths):
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        v = _Visitor(path, specs)
+        v.visit(tree)
+        findings.extend(v.findings)
+    return findings

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -64,6 +65,9 @@ from deepspeed_tpu.fleet.defense import (AdmissionBudget, BreakerState,
                                          OverloadShedError)
 from deepspeed_tpu.fleet.elastic import FleetAutoscaler
 from deepspeed_tpu.fleet.metrics import FleetMetrics
+from deepspeed_tpu.observability.flight_recorder import write_postmortem
+from deepspeed_tpu.observability.tracer import (Tracer, mint_trace_id,
+                                                write_chrome_trace)
 from deepspeed_tpu.resilience import chaos
 from deepspeed_tpu.resilience.chaos import ChaosInjectedError
 from deepspeed_tpu.resilience.supervisor import RestartBudget
@@ -107,6 +111,9 @@ class FleetRequest:
     replays: int = 0                     # crash-replay count
     handoffs: int = 0                    # planned migrations
     on_token: Optional[Callable] = None  # client streaming hook
+    #: distributed-tracing id: minted once at the front door, carried
+    #: through every incarnation via the replay snapshots
+    trace_id: Optional[str] = None
 
     @property
     def done(self) -> bool:
@@ -163,7 +170,7 @@ class FleetRequest:
             generated=list(self.tokens),
             sampling=dataclasses.asdict(self.sampling),
             priority=self.priority, deadline_s=remaining,
-            tenant=self.tenant)
+            tenant=self.tenant, trace_id=self.trace_id)
 
 
 class ServingFleet:
@@ -186,7 +193,11 @@ class ServingFleet:
                  breaker_kwargs: Optional[dict] = None,
                  restart_budget: Optional[RestartBudget] = None,
                  startup_window_s: float = 5.0,
-                 admission: Optional[AdmissionBudget] = None):
+                 admission: Optional[AdmissionBudget] = None,
+                 tracer: Optional[Tracer] = None,
+                 postmortem_dir: Optional[str] = None,
+                 flight_spans: int = 128,
+                 registry=None):
         if (prefill_replicas > 0) != (decode_replicas > 0):
             raise ValueError(
                 "disaggregation needs BOTH prefill_replicas and "
@@ -271,8 +282,27 @@ class ServingFleet:
         self._suspect_queue: List[int] = []
         #: replica name -> uid probed in isolation there
         self._probe: Dict[str, int] = {}
+        # -- observability ---------------------------------------------- #
+        #: one shared tracer across all in-process replicas; spans are
+        #: tid-tagged ``replica#incarnation`` so a kill/replay trace
+        #: shows both incarnations side by side under one trace_id.  The
+        #: ring doubles as the flight recorder's evidence, so it is ON
+        #: by default.
+        self.tracer = tracer if tracer is not None else Tracer(tid="fleet")
+        #: where replica deaths / convictions dump their postmortems
+        #: (None = no files; the ring still holds the evidence)
+        self.postmortem_dir = postmortem_dir
+        #: how many recent spans a postmortem freezes
+        self.flight_spans = int(flight_spans)
+        #: per-replica incarnation counter (span tid suffix)
+        self._incarnation: Dict[str, int] = {}
+        self._postmortem_seq = itertools.count()
+        if registry is not None:
+            registry.register_provider("fleet",
+                                       lambda: self.metrics.snapshot(self))
         for _, rep in self.pool_members():
             self._install_defenses(rep)
+            self._attach_tracer(rep.name, rep.scheduler)
 
     # ------------------------------------------------------------------ #
     # Topology
@@ -286,6 +316,17 @@ class ServingFleet:
     def _next_name(self, prefix: str) -> str:
         ctr = self._name_counters.setdefault(prefix, itertools.count())
         return f"{prefix}{next(ctr)}"
+
+    def _attach_tracer(self, name: str,
+                       sched: ContinuousBatchScheduler) -> None:
+        """Point a replica's scheduler at the fleet tracer, tid-tagged
+        ``name#incarnation`` — every (re)spawn bumps the incarnation so
+        the exported trace distinguishes the lives of one replica."""
+        inc = self._incarnation.get(name, 0)
+        sched.attach_tracer(self.tracer, tid=f"{name}#{inc}")
+
+    def _bump_incarnation(self, name: str) -> None:
+        self._incarnation[name] = self._incarnation.get(name, 0) + 1
 
     def pool_members(self) -> Iterable[Tuple[str, Replica]]:
         """(pool name, replica) for every live replica — reads the
@@ -364,12 +405,14 @@ class ServingFleet:
         uid = next(self._uid_counter)
         fr = FleetRequest(uid=uid, prompt=[int(t) for t in prompt],
                           sampling=sampling or SamplingParams(),
-                          tenant=tenant, on_token=on_token)
+                          tenant=tenant, on_token=on_token,
+                          trace_id=mint_trace_id())
         try:
             req = self.router.submit(
                 fr.prompt, tenant=tenant, priority_class=priority_class,
                 priority=priority, deadline_s=deadline_s,
-                sampling=fr.sampling, on_token=self._hook(fr), uid=uid)
+                sampling=fr.sampling, on_token=self._hook(fr), uid=uid,
+                trace_id=fr.trace_id)
         except Exception:
             # the router's own gates (quota / SLO / queue bound) rejected
             # it AFTER the overload budget was charged: give the tokens
@@ -618,6 +661,10 @@ class ServingFleet:
         # EMPTY the dead scheduler's containers — it may stick around as
         # a broken replica's placeholder (failed respawn), and a later
         # shutdown/downsize on it must find nothing to re-detach
+        # the dead incarnation's open request spans close NOW, tagged
+        # with the death — the replay opens fresh spans under the same
+        # trace_id on the next incarnation
+        dead.abort_request_spans(f"replica_death:{reason}")
         for req in [*dead._queued, *list(dead._running.values()),
                     *dead._preempted]:
             req.finish_reason = "replica_killed"
@@ -673,6 +720,14 @@ class ServingFleet:
             # replica restarted — fleet/restarts must not claim one
             self.metrics.replays += replayed
         self.metrics.record_death(reason)
+        # flight recorder: freeze this death's evidence — the blamed uid
+        # set, verdicts, breaker/budget state, and the dead replica's
+        # last tick/request spans — into one postmortem file
+        self._write_postmortem(
+            reason=reason, replica=name, blamed_uids=blame_set,
+            convicted=convicted,
+            suspects=[u for u in blame_set if self.blame.is_suspect(u)],
+            breaker=rep.breaker)
         logger.warning(
             f"fleet: replica {name} death ({reason}) — "
             f"respawned={not rep.broken}, {replayed} replayed, "
@@ -722,6 +777,8 @@ class ServingFleet:
             return False
         router.replace_replica(name, sched)
         rep.broken = False
+        self._bump_incarnation(name)
+        self._attach_tracer(name, sched)
         if self.restart_budget is not None:
             self.restart_budget.record()
         self._respawned_at[name] = time.monotonic()
@@ -861,11 +918,47 @@ class ServingFleet:
     def _quarantine(self, fr: FleetRequest) -> None:
         msg = self.blame.verdict(fr.uid)
         self._terminalize(fr, "quarantined", error=msg)
+        # a conviction is a flight-recorder event in its own right: the
+        # postmortem names the convicted uid and its verdict BEFORE the
+        # blame table forgets the terminal uid
+        self._write_postmortem(
+            reason="quarantine", replica=fr.replica or "",
+            blamed_uids=[fr.uid], convicted=fr.uid,
+            extra={"verdict": msg, "trace_id": fr.trace_id,
+                   "death_count": self.blame.death_count(fr.uid)})
         self.blame.forget(fr.uid)
         if fr.uid in self._suspect_queue:
             self._suspect_queue.remove(fr.uid)
         self.metrics.record_quarantine()
         logger.error(f"fleet: {msg}")
+
+    def _write_postmortem(self, *, reason: str, replica: str,
+                          blamed_uids, convicted=None, suspects=(),
+                          breaker=None, extra=None) -> Optional[str]:
+        if self.postmortem_dir is None:
+            return None
+        # the dead replica's recent spans, every incarnation of it
+        spans = [e for e in self.tracer.export_events()
+                 if str(e["tid"]).startswith(f"{replica}#")
+                 ][-self.flight_spans:]
+        path = os.path.join(
+            self.postmortem_dir,
+            f"{next(self._postmortem_seq):04d}.{replica or 'fleet'}"
+            f".{reason}.json")
+        return write_postmortem(
+            path, reason=reason, replica=replica,
+            blamed_uids=blamed_uids, convicted=convicted,
+            suspects=suspects, breaker=breaker,
+            budget=self.restart_budget, spans=spans, extra=extra)
+
+    def export_trace(self, path: Optional[str] = None):
+        """The whole fleet's trace events (every replica, every
+        incarnation, the front-door instants) — written as a
+        Chrome/Perfetto trace when ``path`` is given."""
+        events = self.tracer.export_events()
+        if path is not None:
+            write_chrome_trace(path, events)
+        return events
 
     def _replay(self, fr: FleetRequest) -> bool:
         """Continue ``fr`` from the journal on a live replica — unless it
@@ -915,6 +1008,8 @@ class ServingFleet:
             self._collect()
             router.replace_replica(rep.name,
                                    (factory or self.factory)(rep.name))
+            self._bump_incarnation(rep.name)
+            self._attach_tracer(rep.name, rep.scheduler)
             # a planned upgrade is still a respawn: a crash right after
             # it counts against the breaker's startup window (bad new
             # binary/config reads exactly like a sick host)
@@ -975,6 +1070,7 @@ class ServingFleet:
             name = self._next_name(prefix)
             rep = router.add_replica(name, self.factory(name))
             self._install_defenses(rep)
+            self._attach_tracer(name, rep.scheduler)
             self.metrics.record_scale(+1)
         while len(router.replicas) > max(target, 1):
             # broken replicas are dead capacity holding no work: always

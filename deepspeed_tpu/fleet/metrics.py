@@ -20,6 +20,38 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.observability.registry import MetricsRegistry
+
+
+def _declare(reg: MetricsRegistry) -> None:
+    """Declare every ``fleet/*`` name :meth:`FleetMetrics.snapshot` can
+    emit (incl. the router rollup and per-pool families)."""
+    for n in ("restarts", "replayed_requests", "handoffs", "scale_ups",
+              "scale_downs", "rolling_restarts", "quarantined",
+              "replay_budget_failed", "isolation_probes",
+              "breaker_opens", "breaker_closes", "shed_total",
+              "requests", "requests_finished", "requests_failed",
+              "submitted", "finished", "failed", "preemptions",
+              "total_tokens"):
+        reg.counter(f"fleet/{n}")
+    for n in ("requests_live", "replicas", "replicas_broken",
+              "breakers_open", "suspects_pending",
+              "goodput_tokens_per_s", "spec_accept_rate",
+              "p50_handoff_s", "p95_handoff_s"):
+        reg.gauge(f"fleet/{n}")
+    # derived families: per-class sheds, per-reason deaths, per-pool
+    # replica/queue gauges, speculative rollup, and the router snapshot
+    reg.counter("fleet/shed_*", help="overload sheds by priority class")
+    reg.counter("fleet/deaths_*", help="incarnation deaths by reason")
+    reg.gauge("fleet/replicas_*", help="replica count per pool")
+    reg.gauge("fleet/queue_depth_*", help="token backlog per pool")
+    reg.gauge("fleet/pending_*", help="pending requests per pool")
+    reg.gauge("fleet/spec_*", help="speculative decoding rollup")
+    reg.gauge("fleet/router_*", help="router placement/admission rollup")
+
+
+_declare(MetricsRegistry.default())
+
 
 class FleetMetrics:
     """Aggregates a :class:`~deepspeed_tpu.fleet.fleet.ServingFleet`'s

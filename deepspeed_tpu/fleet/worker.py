@@ -50,6 +50,9 @@ from typing import Callable, Dict, List, Optional
 
 from deepspeed_tpu.fleet.defense import CrashBlame
 from deepspeed_tpu.fleet.fleet import FleetRequest
+from deepspeed_tpu.observability.flight_recorder import (FlightRecorder,
+                                                         write_postmortem)
+from deepspeed_tpu.observability.tracer import Tracer, mint_trace_id
 from deepspeed_tpu.resilience import heartbeat as hb
 from deepspeed_tpu.resilience.supervisor import (BackoffPolicy,
                                                  JobSupervisor, WorkerSpec)
@@ -68,12 +71,20 @@ def events_path(spool_dir: str, attempt: int) -> str:
     return os.path.join(spool_dir, f"events.{attempt}.jsonl")
 
 
+def flight_path(spool_dir: str, attempt: int) -> str:
+    """The worker incarnation's flight-recorder file: its span ring,
+    flushed periodically (atomic rename) so a SIGKILL loses at most the
+    last ``flush_every`` ticks of spans, never the whole black box."""
+    return os.path.join(spool_dir, f"flight.{attempt}.json")
+
+
 # --------------------------------------------------------------------- #
 # Worker side
 # --------------------------------------------------------------------- #
 def run_replica_worker(spool_dir: str, scheduler,
                        poll_s: float = 0.005,
-                       drain_deadline_s: float = 30.0) -> int:
+                       drain_deadline_s: float = 30.0,
+                       flight_flush_every: int = 16) -> int:
     """Serve one replica until the front-end drops a ``stop`` file.
 
     Per loop iteration: consume inbox snapshots (read + unlink, then
@@ -87,6 +98,16 @@ def run_replica_worker(spool_dir: str, scheduler,
     stop_path = os.path.join(spool_dir, STOP_FILE)
     seen_finished = 0
     attempt = int(os.environ.get(ENV_INCARNATION, "0"))
+    # black box: tick/request spans land in the scheduler's tracer ring
+    # and flush to the crash-durable flight file every few ticks — the
+    # front-end folds the last flushed ring into the postmortem when
+    # this process is SIGKILLed (a killed process cannot dump)
+    if getattr(scheduler, "tracer", None) is None:
+        name = os.path.basename(os.path.normpath(spool_dir))
+        scheduler.attach_tracer(Tracer(tid=f"{name}#{attempt}"))
+    recorder = FlightRecorder(scheduler.tracer,
+                              flight_path(spool_dir, attempt),
+                              flush_every=flight_flush_every)
     with open(events_path(spool_dir, attempt), "a") as ev:
 
         def flush_finished() -> None:
@@ -125,11 +146,13 @@ def run_replica_worker(spool_dir: str, scheduler,
                 scheduler.shutdown(drain_deadline_s)
                 flush_finished()
                 os.fsync(ev.fileno())
+                recorder.flush()
                 return 0
             if scheduler.num_pending:
                 for req, tok in scheduler.step():
                     ev.write(json.dumps({"uid": req.uid,
                                          "tok": int(tok)}) + "\n")
+                recorder.tick()
             else:
                 hb.tick_active()        # idle replicas are not hung
                 time.sleep(poll_s)
@@ -164,6 +187,11 @@ class FleetFrontEnd:
             raise ValueError("FleetFrontEnd needs at least one replica")
         self.run_dir = run_dir
         os.makedirs(run_dir, exist_ok=True)
+        #: flight-recorder postmortems land here on worker death /
+        #: poison conviction (the spans come from the dead worker's last
+        #: flushed ``flight.<attempt>.json`` ring)
+        self.postmortem_dir = os.path.join(run_dir, "postmortem")
+        self._postmortem_seq = itertools.count()
         self._uid_counter = itertools.count(1)
         self._rr = itertools.count()
         self.requests: Dict[int, FleetRequest] = {}
@@ -279,7 +307,7 @@ class FleetFrontEnd:
         uid = next(self._uid_counter)
         fr = FleetRequest(uid=uid, prompt=[int(t) for t in prompt],
                           sampling=sampling or SamplingParams(),
-                          tenant=tenant)
+                          tenant=tenant, trace_id=mint_trace_id())
         self.requests[uid] = fr
         self._n_live += 1
         self._dispatch(fr)
@@ -308,6 +336,11 @@ class FleetFrontEnd:
     def _quarantine(self, fr: FleetRequest) -> None:
         msg = self.blame.verdict(fr.uid, host_kind="worker")
         self._terminalize(fr, "quarantined", error=msg)
+        self._write_postmortem(
+            reason="quarantine", replica=fr.replica or "",
+            blamed_uids=[fr.uid], convicted=fr.uid,
+            extra={"verdict": msg, "trace_id": fr.trace_id,
+                   "death_count": self.blame.death_count(fr.uid)})
         self.blame.forget(fr.uid)
         if fr.uid in self._suspect_queue:
             self._suspect_queue.remove(fr.uid)
@@ -411,6 +444,7 @@ class FleetFrontEnd:
                 # flushed token BEFORE building replay snapshots
                 for old in range(self.restarts_seen[name], sup.attempt):
                     self._drain_events(name, attempt=old, final=True)
+                dead_attempt = sup.attempt - 1
                 self.restarts_seen[name] = sup.attempt
                 # unconsumed inbox files would make the respawned worker
                 # re-run requests we are about to replay elsewhere
@@ -469,6 +503,15 @@ class FleetFrontEnd:
                         self.replays += 1
                         self._dispatch(fr)
                         replayed += 1
+                # flight recorder: the dead incarnation's last flushed
+                # span ring + this death's verdicts, one postmortem file
+                self._write_postmortem(
+                    reason="crash", replica=name,
+                    blamed_uids=blame_set, convicted=convicted,
+                    suspects=suspect_uids,
+                    spans=FlightRecorder.read_flight(
+                        flight_path(self.spools[name], dead_attempt)),
+                    extra={"attempt": dead_attempt})
                 logger.warning(
                     f"fleet front-end: replica {name} restarted "
                     f"(attempt {sup.attempt}) — {replayed} replayed, "
@@ -476,6 +519,18 @@ class FleetFrontEnd:
                     f"quarantined="
                     f"{convicted if convicted is not None else 'none'}")
         self._pump_isolation()
+
+    def _write_postmortem(self, *, reason: str, replica: str,
+                          blamed_uids, convicted=None, suspects=(),
+                          spans=(), extra=None) -> str:
+        path = os.path.join(
+            self.postmortem_dir,
+            f"{next(self._postmortem_seq):04d}.{replica or 'frontend'}"
+            f".{reason}.json")
+        return write_postmortem(
+            path, reason=reason, replica=replica,
+            blamed_uids=blamed_uids, convicted=convicted,
+            suspects=suspects, spans=spans, extra=extra)
 
     def _pump_isolation(self) -> None:
         """Dispatch queued suspects, each ALONE onto a worker with

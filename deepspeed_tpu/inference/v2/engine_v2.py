@@ -31,6 +31,7 @@ from deepspeed_tpu.inference.v2.model_implementations.ragged_llama import (
 )
 from deepspeed_tpu.inference.v2.ragged import (DSStateManager,
                                                RaggedBatchWrapper)
+from deepspeed_tpu.observability.tracer import annotate
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -333,9 +334,13 @@ class InferenceEngineV2:
                          if b >= self._batch.current_tokens)
         meta = self._batch.finalize(bucket)
         packed = jnp.asarray(pack_metadata(meta))  # ONE upload
-        logits, new_cache = self._get_step(
-            bucket, tile if use_tiles else None)(
-            self.params, sm.kv_cache.cache, packed)
+        # host↔device alignment: a jax.profiler capture shows this named
+        # bracket on the host track lined up with the XLA execution it
+        # dispatched (annotate() is a shared no-op unless enabled)
+        with annotate("engine/ragged_step"):
+            logits, new_cache = self._get_step(
+                bucket, tile if use_tiles else None)(
+                self.params, sm.kv_cache.cache, packed)
         sm.kv_cache.update(new_cache)
 
         out: Dict[int, np.ndarray] = {}
@@ -426,9 +431,10 @@ class InferenceEngineV2:
         if state is None or tables_changed or state["key"] != key:
             state = self._upload_decode_state(seqs, key)
         try:
-            logits, nxt, new_cache, new_pos = self._get_decode_step()(
-                self.params, sm.kv_cache.cache, state["tables"],
-                state["pos"], self._as_token_array(tokens, n, S))
+            with annotate("engine/decode_step"):
+                logits, nxt, new_cache, new_pos = self._get_decode_step()(
+                    self.params, sm.kv_cache.cache, state["tables"],
+                    state["pos"], self._as_token_array(tokens, n, S))
         except Exception:
             self._recover_donated_cache()
             raise
@@ -572,8 +578,9 @@ class InferenceEngineV2:
         packed = jnp.asarray(np.concatenate(
             [tables.ravel(), pos, tok.ravel()]))       # ONE upload
         try:
-            logits, nxt, new_cache = self._get_verify_step(K)(
-                self.params, sm.kv_cache.cache, packed)
+            with annotate("engine/verify_step"):
+                logits, nxt, new_cache = self._get_verify_step(K)(
+                    self.params, sm.kv_cache.cache, packed)
         except Exception:
             # same donated-cache hazard as decode_step: with speculation
             # on, THIS is the steady-state tick, so it needs the same

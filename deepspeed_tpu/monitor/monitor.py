@@ -125,6 +125,14 @@ class WandbMonitor(Monitor):
 
 
 class CSVMonitor(Monitor):
+    """One CSV file per series.  Durability contract: every
+    ``write_events`` call (a step/export boundary) groups its rows by
+    file, appends them under ONE open, and flush+fsyncs before close —
+    a SIGKILL mid-run (the fleet smoke's whole point) loses at most the
+    final torn row, never the series.  Parent directories are
+    (re)created at write time, not only at init: a worker respawned
+    after its run dir was cleaned must not silently drop telemetry."""
+
     def __init__(self, config):
         super().__init__(config)
         self.output_path = None
@@ -136,16 +144,44 @@ class CSVMonitor(Monitor):
     def write_events(self, events: List[Event]) -> None:
         if not self.output_path:
             return
-        for name, value, step in events:
+        by_file: "dict[str, List[Event]]" = {}
+        for ev in events:
             fname = os.path.join(self.output_path,
-                                 name.replace("/", "_") + ".csv")
+                                 ev[0].replace("/", "_") + ".csv")
+            by_file.setdefault(fname, []).append(ev)
+        for fname, evs in by_file.items():
+            os.makedirs(os.path.dirname(fname), exist_ok=True)
             new = not os.path.exists(fname)
             with open(fname, "a", newline="") as f:
                 w = csv.writer(f)
                 if new:
-                    w.writerow(["step", name])
-                w.writerow([float(step) if _is_wallclock(step)
-                            else int(step), float(value)])
+                    w.writerow(["step", evs[0][0]])
+                for name, value, step in evs:
+                    w.writerow([float(step) if _is_wallclock(step)
+                                else int(step), float(value)])
+                f.flush()
+                os.fsync(f.fileno())
+
+
+def read_csv_series(path: str) -> List[Tuple[float, float]]:
+    """Read one CSVMonitor series back, tolerating a torn final line
+    (the row a kill interrupted mid-write): complete ``(x, value)`` rows
+    parse, the torn tail is skipped — never a crash, never data before
+    it lost."""
+    out: List[Tuple[float, float]] = []
+    try:
+        with open(path, newline="") as f:
+            rows = list(csv.reader(f))
+    except OSError:
+        return out
+    for row in rows[1:] if rows and rows[0][:1] == ["step"] else rows:
+        if len(row) != 2:
+            continue
+        try:
+            out.append((float(row[0]), float(row[1])))
+        except ValueError:
+            continue                      # torn/partial row
+    return out
 
 
 class MonitorMaster:

@@ -1,0 +1,42 @@
+"""Observability layer: request-scoped distributed tracing, the unified
+metrics registry, and the crash flight recorder (the TPU-native
+counterpart of the reference's ``profiling/`` + ``monitor/`` layers).
+
+Typical use::
+
+    from deepspeed_tpu.observability import Tracer, write_chrome_trace
+
+    tracer = Tracer(tid="replica0")
+    sched = ContinuousBatchScheduler(engine, tracer=tracer)
+    ...drive traffic...
+    write_chrome_trace("trace.json", tracer.export_events())
+    # -> load in https://ui.perfetto.dev
+
+Every request carries a ``trace_id`` minted at submit; spans from every
+replica incarnation it touches (kill→replay, rolling restarts,
+disaggregated prefill→decode handoff) share that id, so the exported
+timeline shows ONE request's whole life.  ``tools/obs_dump.py`` renders
+and schema-validates the export.
+"""
+
+from deepspeed_tpu.observability.flight_recorder import (FlightRecorder,
+                                                         list_postmortems,
+                                                         load_postmortem,
+                                                         write_postmortem)
+from deepspeed_tpu.observability.registry import (MetricSpec,
+                                                  MetricsRegistry,
+                                                  default_registry)
+from deepspeed_tpu.observability.tracer import (Tracer, annotate,
+                                                device_annotations_enabled,
+                                                enable_device_annotations,
+                                                load_chrome_trace,
+                                                merge_events, mint_trace_id,
+                                                step_annotation,
+                                                write_chrome_trace)
+
+__all__ = ["FlightRecorder", "MetricSpec", "MetricsRegistry", "Tracer",
+           "annotate", "default_registry", "device_annotations_enabled",
+           "enable_device_annotations", "list_postmortems",
+           "load_chrome_trace", "load_postmortem", "merge_events",
+           "mint_trace_id", "step_annotation", "write_chrome_trace",
+           "write_postmortem"]

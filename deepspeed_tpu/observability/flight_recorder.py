@@ -1,0 +1,172 @@
+"""Crash flight recorder: when a replica dies, dump the evidence.
+
+Every replica already keeps the evidence in RAM — the tracer's bounded
+span ring (the last N tick/request spans), the blame tracker's verdicts,
+the breaker/budget state.  On replica death, tick-watchdog firing, or a
+poison conviction, :func:`write_postmortem` freezes it all into one JSON
+file, so the post-incident question "what was this replica doing when it
+died, and who is to blame?" is answered by ``cat``, not by archaeology
+across four metric namespaces.
+
+Two capture paths:
+
+* **in-process replicas** (``ServingFleet``): the fleet shares one
+  tracer across replicas (spans are tid-tagged per replica), so the
+  death handler snapshots the dead replica's span tail directly;
+* **subprocess workers** (``fleet.worker``): a SIGKILL'd process cannot
+  dump anything, so the worker's :class:`FlightRecorder` periodically
+  flushes its span ring to a crash-durable ``flight.<attempt>.json``
+  (atomic rename), and the FRONT-END folds the last flushed ring into
+  the postmortem it writes on crash detection — the classic black-box
+  recorder: slightly stale, never lost.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from deepspeed_tpu.observability.tracer import Tracer
+
+SCHEMA = "ds-postmortem-v1"
+
+
+def _describe_breaker(breaker) -> Optional[Dict[str, Any]]:
+    if breaker is None:
+        return None
+    if isinstance(breaker, dict):
+        return dict(breaker)
+    return {
+        "state": breaker.state.value,
+        "failures": int(breaker.failures),
+        "opens": int(breaker.opens),
+        "cooloff_s": float(breaker.cooloff_s),
+    }
+
+
+def _describe_budget(budget) -> Optional[Dict[str, Any]]:
+    if budget is None:
+        return None
+    if isinstance(budget, dict):
+        return dict(budget)
+    if hasattr(budget, "snapshot"):        # AdmissionBudget
+        return {k: float(v) for k, v in budget.snapshot().items()}
+    if hasattr(budget, "in_window"):       # RestartBudget
+        return {"in_window": int(budget.in_window()),
+                "max_restarts": int(budget.max_restarts),
+                "exhausted": bool(budget.exhausted())}
+    return {"repr": repr(budget)}
+
+
+def _atomic_write_json(path: str, payload: dict) -> str:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def write_postmortem(path: str, *, reason: str, replica: str,
+                     blamed_uids: Sequence[int] = (),
+                     convicted: Optional[int] = None,
+                     suspects: Sequence[int] = (),
+                     breaker=None, budget=None,
+                     spans: Sequence[dict] = (),
+                     extra: Optional[dict] = None) -> str:
+    """Freeze one replica death's evidence to ``path`` (atomic; parent
+    dirs created).  ``spans`` is the dead replica's recent trace-event
+    tail (``Tracer.export_events``-shaped dicts)."""
+    payload = {
+        "schema": SCHEMA,
+        "wall_time": time.time(),
+        "reason": reason,
+        "replica": replica,
+        "blamed_uids": sorted(int(u) for u in blamed_uids),
+        "convicted_uid": None if convicted is None else int(convicted),
+        "suspect_uids": sorted(int(u) for u in suspects),
+        "breaker": _describe_breaker(breaker),
+        "budget": _describe_budget(budget),
+        "spans": list(spans),
+        **({"extra": extra} if extra else {}),
+    }
+    return _atomic_write_json(path, payload)
+
+
+def load_postmortem(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("schema") != SCHEMA:
+        raise ValueError(f"{path}: not a {SCHEMA} postmortem "
+                         f"(schema={data.get('schema')!r})")
+    return data
+
+
+def list_postmortems(dirpath: str) -> List[str]:
+    """Postmortem files under ``dirpath``, oldest first."""
+    if not os.path.isdir(dirpath):
+        return []
+    out = [os.path.join(dirpath, n) for n in os.listdir(dirpath)
+           if n.endswith(".json") and not n.endswith(".tmp")]
+    out.sort(key=lambda p: (os.path.getmtime(p), p))
+    return out
+
+
+class FlightRecorder:
+    """A worker-side black box over a :class:`Tracer` ring.
+
+    ``tick()`` counts scheduler ticks and every ``flush_every`` of them
+    rewrites ``flight_path`` with the current span tail (atomic rename —
+    a SIGKILL mid-flush leaves the previous intact).  The front-end
+    reads the last flushed ring with :meth:`read_flight` when the worker
+    dies without warning."""
+
+    def __init__(self, tracer: Tracer, flight_path: Optional[str] = None,
+                 flush_every: int = 16, last_n: int = 256):
+        self.tracer = tracer
+        self.flight_path = flight_path
+        self.flush_every = max(int(flush_every), 1)
+        self.last_n = last_n
+        self._ticks = 0
+        self.flushes = 0
+
+    def recent_spans(self, tid: Optional[str] = None,
+                     n: Optional[int] = None) -> List[dict]:
+        return self.tracer.export_events(
+            tail=n if n is not None else self.last_n, tid=tid)
+
+    def tick(self) -> None:
+        self._ticks += 1
+        if self.flight_path is not None \
+                and self._ticks % self.flush_every == 0:
+            self.flush()
+
+    def flush(self) -> None:
+        if self.flight_path is None:
+            return
+        _atomic_write_json(self.flight_path, {
+            "schema": "ds-flight-v1",
+            "wall_time": time.time(),
+            "ticks": self._ticks,
+            "spans": self.recent_spans(),
+        })
+        self.flushes += 1
+
+    @staticmethod
+    def read_flight(path: str) -> List[dict]:
+        """The last flushed span ring, or [] when the worker died before
+        its first flush (or the file is torn — rename makes that rare)."""
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return []
+        if data.get("schema") != "ds-flight-v1":
+            return []
+        return list(data.get("spans", []))

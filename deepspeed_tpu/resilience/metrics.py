@@ -14,6 +14,24 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
+from deepspeed_tpu.observability.registry import MetricsRegistry
+
+
+def _declare(reg: MetricsRegistry) -> None:
+    """Declare every ``resilience/*`` name :meth:`snapshot` emits."""
+    for n in ("saves", "save_failures", "verify_failures", "fallbacks",
+              "resumes", "rollbacks", "skipped_steps", "gc_deleted_tags",
+              "restart_total", "restart_crash", "restart_hang",
+              "restart_startup", "hangs", "escalations",
+              "blacklisted_hosts"):
+        reg.counter(f"resilience/{n}")
+    for n in ("save_latency_s", "mean_save_latency_s", "restart_attempt",
+              "restart_backoff_s", "world_size"):
+        reg.gauge(f"resilience/{n}")
+
+
+_declare(MetricsRegistry.default())
+
 
 class ResilienceMetrics:
     def __init__(self, monitor=None):
@@ -131,3 +149,10 @@ class ResilienceMetrics:
         if monitor is not None and getattr(monitor, "enabled", False):
             monitor.write_events(events)
         return events
+
+    def register_into(self, registry, key: str = "resilience") -> None:
+        """Join the unified :class:`MetricsRegistry`: one ``snapshot()``/
+        ``export()`` path alongside the serving/fleet providers."""
+        registry.register_provider(
+            key, lambda: {f"resilience/{k}": float(v)
+                          for k, v in self.snapshot().items()})

@@ -21,7 +21,33 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.observability.registry import MetricsRegistry
 from deepspeed_tpu.serving.request import Request
+
+
+def _declare(reg: MetricsRegistry) -> None:
+    """Declare every ``serving/*`` name this module (and the scheduler's
+    extra telemetry) can emit — the contract the metric-name lint checks
+    string literals against and the exposition types names with."""
+    for n in ("submitted", "rejected", "finished", "failed",
+              "deadline_exceeded", "shutdown_failed", "preemptions",
+              "handoffs", "preempted_requests", "total_tokens",
+              "decode_ticks", "decode_tokens_delivered",
+              "fast_decode_ticks"):
+        reg.counter(f"serving/{n}")
+    for n in ("preemption_rate", "goodput_tokens_per_s",
+              "overall_tokens_per_s", "tokens_per_decode_tick",
+              "tokens_per_request_tick", "tpot_delivered_s"):
+        reg.gauge(f"serving/{n}", unit="s" if n.endswith("_s") else "")
+    reg.histogram("serving/p50_*", help="rolling percentile series")
+    reg.histogram("serving/p95_*", help="rolling percentile series")
+    #: scheduler-attached telemetry families (speculative decode stats,
+    #: radix prefix-cache stats) — derived names, declared as families
+    reg.gauge("serving/spec_*", help="speculative decoding stats")
+    reg.gauge("serving/prefix_*", help="radix prefix-cache stats")
+
+
+_declare(MetricsRegistry.default())
 
 
 def _pct(values: List[float], q: float) -> float:
@@ -207,6 +233,7 @@ class ServingMetrics:
     # ------------------------------------------------------------------ #
     def export(self, monitor=None, now: Optional[float] = None,
                extra: Optional[List[Tuple[str, float]]] = None,
+               snapshot: Optional[Dict[str, float]] = None,
                ) -> List[Tuple[str, float, float]]:
         """Emit ``serving/*`` scalars through the monitor writers.
 
@@ -214,13 +241,17 @@ class ServingMetrics:
         step numbers; the writers persist it as-is (CSV), or as the
         TensorBoard walltime axis.  ``extra`` appends caller-supplied
         ``(name, value)`` scalars (the scheduler's prefix-cache and
-        fast-tick telemetry) at the same x.  Returns the event list (also
-        when no monitor is attached, for callers that fan out themselves).
+        fast-tick telemetry) at the same x.  ``snapshot`` reuses a
+        snapshot the caller already computed (percentiles are not free).
+        Returns the event list (also when no monitor is attached, for
+        callers that fan out themselves).
         """
         monitor = monitor if monitor is not None else self.monitor
         wall = time.time() if now is None else now
+        if snapshot is None:
+            snapshot = self.snapshot()
         events = [(f"serving/{k}", v, wall)
-                  for k, v in self.snapshot().items()]
+                  for k, v in snapshot.items()]
         if extra:
             events.extend((name, float(v), wall) for name, v in extra)
         if monitor is not None and getattr(monitor, "enabled", False):

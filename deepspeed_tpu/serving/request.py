@@ -122,6 +122,12 @@ class Request:
     #: directly to a scheduler
     tenant: Optional[str] = None
     replica: Optional[str] = None
+    #: distributed-tracing id, minted ONCE at first submit and carried
+    #: through every replica incarnation via :class:`RequestSnapshot` —
+    #: spans from a kill→replay, a rolling-restart migration, and a
+    #: disaggregated prefill→decode handoff all share it, so the
+    #: exported timeline shows one request's whole life
+    trace_id: Optional[str] = None
 
     # -- per-request SLO accounting (wall-clock, time.monotonic) ------- #
     first_scheduled_time: Optional[float] = None
@@ -219,6 +225,7 @@ class Request:
             tenant=self.tenant,
             preemptions=self.preemptions,
             fed_tokens=fed_tokens,
+            trace_id=self.trace_id,
         )
 
     # -- derived SLO metrics ------------------------------------------- #
@@ -277,6 +284,9 @@ class RequestSnapshot:
     #: leading ``history`` tokens whose KV travels with the snapshot
     #: (``flush_to_host(include_kv=True)`` payload); 0 = recompute-replay
     fed_tokens: int = 0
+    #: the request's distributed-tracing id — it travels WITH the
+    #: snapshot so the continuation's spans join the same trace
+    trace_id: Optional[str] = None
 
     @property
     def history(self) -> List[int]:
@@ -296,6 +306,7 @@ class RequestSnapshot:
         req.generated = list(self.generated)
         req.preemptions = self.preemptions
         req.tenant = self.tenant
+        req.trace_id = self.trace_id
         return req
 
     def to_json(self) -> str:

@@ -292,7 +292,8 @@ class CacheAwareRouter:
                priority: Optional[int] = None,
                deadline_s: Optional[float] = None,
                sampling: Optional[SamplingParams] = None,
-               on_token=None, uid: Optional[int] = None) -> Request:
+               on_token=None, uid: Optional[int] = None,
+               trace_id: Optional[str] = None) -> Request:
         """Admit one request through quota/priority/SLO gates and place it
         on the cache-affine replica.  The returned :class:`Request` is
         annotated with ``.replica`` (name) and ``.tenant``.  Raises
@@ -340,7 +341,8 @@ class CacheAwareRouter:
             raise slo_err
         req = rep.scheduler.submit(
             prompt, sampling=sampling, priority=priority or 0,
-            deadline_s=deadline_s, on_token=on_token, uid=uid)
+            deadline_s=deadline_s, on_token=on_token, uid=uid,
+            trace_id=trace_id)
         req.tenant = tenant
         req.replica = rep.name
         # prune finished requests even when no quota gated this tenant —

@@ -27,6 +27,7 @@ jitted ragged step — the same split the reference keeps.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -36,6 +37,8 @@ import numpy as np
 from deepspeed_tpu.inference.v2.speculative import (SpeculativeConfig,
                                                     SpeculativeStats,
                                                     accept_drafts)
+from deepspeed_tpu.observability.tracer import (Tracer, mint_trace_id,
+                                                step_annotation)
 from deepspeed_tpu.resilience import chaos
 from deepspeed_tpu.resilience.heartbeat import Heartbeat
 from deepspeed_tpu.serving.metrics import ServingMetrics
@@ -43,6 +46,8 @@ from deepspeed_tpu.serving.request import (Request, RequestState,
                                            SamplingParams)
 from deepspeed_tpu.serving.sampler import sample_batch
 from deepspeed_tpu.utils.logging import logger
+
+_NULL_CM = contextlib.nullcontext()
 
 
 class QueueFullError(RuntimeError):
@@ -79,8 +84,33 @@ class ContinuousBatchScheduler:
                  max_queue: Optional[int] = None,
                  fast_decode: bool = True,
                  tick_deadline_s: Optional[float] = None,
-                 speculative: Optional[SpeculativeConfig] = None):
+                 speculative: Optional[SpeculativeConfig] = None,
+                 tracer: Optional[Tracer] = None,
+                 registry=None, registry_key: str = "serving"):
         self.engine = engine
+        #: request-scoped tracing (None = zero-overhead off).  Tick
+        #: phases (pack, prefill, decode/verify, sample, emit) record as
+        #: child spans under a per-tick span on the scheduler's own
+        #: trace; request lifecycle spans carry each request's trace_id.
+        #: The fleet re-points tracer/trace_tid at respawn so spans are
+        #: tagged ``replica#incarnation``.
+        self.tracer = tracer
+        self.trace_tid = tracer.default_tid if tracer is not None \
+            else "scheduler"
+        #: the tick timeline's own trace (request traces are per-request)
+        self.sched_trace_id = mint_trace_id()
+        #: uid -> open request-phase SpanHandle
+        self._req_spans: Dict[int, object] = {}
+        #: unified metrics registry (observability.registry): when given,
+        #: this scheduler's serving/* snapshot registers as a provider
+        #: under the STABLE ``registry_key`` — a respawned scheduler
+        #: registering the same key supersedes its dead incarnation
+        #: (an id()-keyed scheme would leak dead engines into the
+        #: registry and let a stale provider shadow the live one)
+        self._registry = registry
+        self._registry_key = registry_key
+        if registry is not None:
+            registry.register_provider(registry_key, self.telemetry)
         #: speculative decoding (ROADMAP item 1): pure-decode ticks run a
         #: drafter + one multi-token verify_step instead of decode_step,
         #: emitting 1..draft_k+1 tokens per weight pass; a tick with no
@@ -153,7 +183,8 @@ class ContinuousBatchScheduler:
                sampling: Optional[SamplingParams] = None,
                priority: int = 0, uid: Optional[int] = None,
                on_token=None, deadline_s: Optional[float] = None,
-               request: Optional[Request] = None) -> Request:
+               request: Optional[Request] = None,
+               trace_id: Optional[str] = None) -> Request:
         """Enqueue one generation request; returns the tracked
         :class:`Request` (read its ``state``/``generated`` as it runs)."""
         if request is None:
@@ -170,7 +201,11 @@ class ContinuousBatchScheduler:
                 prompt=[int(t) for t in prompt],
                 sampling=sampling or SamplingParams(),
                 priority=priority, deadline_s=deadline_s,
-                on_token=on_token)
+                on_token=on_token, trace_id=trace_id)
+        # a replayed/handed-off request keeps its original trace_id (the
+        # whole point: one trace across incarnations); fresh ones mint
+        if request.trace_id is None:
+            request.trace_id = mint_trace_id()
         if self._shutting_down:
             self.metrics.record_reject(request)
             raise RuntimeError(
@@ -203,10 +238,58 @@ class ContinuousBatchScheduler:
         self._live_uids.add(request.uid)
         self._parked_backlog += self._work(request)
         self.metrics.record_submit(request)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tr.instant("request/submit", trace_id=request.trace_id,
+                       tid=self.trace_tid,
+                       attrs={"uid": request.uid,
+                              "prompt_tokens": len(request.prompt),
+                              "resumed": len(request.generated)})
         return request
 
     def _is_tracked_uid(self, uid: int) -> bool:
         return uid in self._live_uids
+
+    def unregister_metrics(self) -> None:
+        """Detach this scheduler's provider from the registry (teardown
+        of a scheduler that is NOT being superseded under its key)."""
+        if self._registry is not None:
+            self._registry.unregister_provider(self._registry_key)
+
+    def attach_tracer(self, tracer: Optional[Tracer],
+                      tid: Optional[str] = None) -> None:
+        """Point this scheduler at ``tracer``, spans tid-tagged ``tid``
+        (default: the tracer's own tid).  The tracer/trace_tid pair must
+        move together — this is the one place that knows that."""
+        self.tracer = tracer
+        if tracer is not None:
+            self.trace_tid = tid if tid is not None else tracer.default_tid
+
+    # ------------------------------------------------------------------ #
+    # Request-phase spans (one open phase per live request)
+    # ------------------------------------------------------------------ #
+    def _open_req_span(self, req: Request, phase: str) -> None:
+        tr = self.tracer
+        if tr is None or not tr.enabled:
+            return
+        self._close_req_span(req.uid)
+        self._req_spans[req.uid] = tr.start(
+            f"request/{phase}", trace_id=req.trace_id, tid=self.trace_tid,
+            attrs={"uid": req.uid, "fed": req.fed,
+                   "generated": len(req.generated)})
+
+    def _close_req_span(self, uid: int, **attrs) -> None:
+        h = self._req_spans.pop(uid, None)
+        if h is not None and self.tracer is not None:
+            self.tracer.finish(h, attrs=attrs or None)
+
+    def abort_request_spans(self, outcome: str) -> None:
+        """Close every open request-phase span.  The fleet calls this on
+        a replica death so the dead incarnation's spans export closed
+        and tagged with the outcome instead of dangling — the request's
+        NEXT incarnation opens fresh spans under the same trace_id."""
+        for uid in list(self._req_spans):
+            self._close_req_span(uid, outcome=outcome)
 
     # ------------------------------------------------------------------ #
     # State inspection
@@ -255,14 +338,35 @@ class ContinuousBatchScheduler:
         ``(request, token)`` pairs emitted this tick."""
         if self._heartbeat is not None:
             self._heartbeat.beat(self._tick)
+        tr = self.tracer
+        tracing = tr is not None and tr.enabled
+        tick_h = tr.start("tick", trace_id=self.sched_trace_id,
+                          tid=self.trace_tid,
+                          attrs={"tick": self._tick}) if tracing else None
+        try:
+            return self._step_traced(tr, tick_h)
+        finally:
+            if tick_h is not None:
+                tr.finish(tick_h)
+
+    def _phase(self, name: str, tick_h):
+        """Child span for one tick phase (no-op context without a
+        tracer/tick span)."""
+        if tick_h is None:
+            return _NULL_CM
+        return self.tracer.span(name, trace_id=self.sched_trace_id,
+                                parent=tick_h.span_id, tid=self.trace_tid)
+
+    def _step_traced(self, tr, tick_h) -> List[Tuple[Request, int]]:
         self._expire_deadlines()
         self._reap_unservable()
         uids: List[int] = []
         chunks: List[List[int]] = []
         packed: List[Request] = []
 
-        self._pack_decodes(uids, chunks, packed)
-        self._pack_prefills(uids, chunks, packed)
+        with self._phase("pack", tick_h):
+            self._pack_decodes(uids, chunks, packed)
+            self._pack_prefills(uids, chunks, packed)
 
         if not uids:
             self._handle_stall()
@@ -284,20 +388,31 @@ class ContinuousBatchScheduler:
         t0 = time.monotonic()
         chaos.fire("tick_stall")
         decode_tick = all(r.state is RequestState.DECODE for r in packed)
-        if self.fast_decode and decode_tick:
-            emitted = None
-            if self.speculative is not None:
-                emitted = self._speculative_decode_tick(uids, chunks,
-                                                        packed)
-            if emitted is None:
+        with step_annotation(self._tick):
+            if self.fast_decode and decode_tick:
+                emitted = None
                 if self.speculative is not None:
-                    self.spec_stats.fallback_ticks += 1
-                emitted = self._fast_decode_tick(uids, chunks, packed)
-        else:
-            logits = self.engine.put(uids, chunks, sync=True)
-            for req, chunk in zip(packed, chunks):
-                req.fed += len(chunk)
-            emitted = self._sample_and_advance(packed, logits)
+                    with self._phase("verify", tick_h):
+                        emitted = self._speculative_decode_tick(
+                            uids, chunks, packed)
+                if emitted is None:
+                    if self.speculative is not None:
+                        self.spec_stats.fallback_ticks += 1
+                    with self._phase("decode", tick_h):
+                        emitted = self._fast_decode_tick(uids, chunks,
+                                                         packed)
+            else:
+                with self._phase("prefill", tick_h):
+                    logits = self.engine.put(uids, chunks, sync=True)
+                    for req, chunk in zip(packed, chunks):
+                        req.fed += len(chunk)
+                with self._phase("sample", tick_h):
+                    emitted = self._sample_and_advance(packed, logits)
+        if tick_h is not None and emitted:
+            tr.instant("emit", trace_id=self.sched_trace_id,
+                       parent=tick_h.span_id, tid=self.trace_tid,
+                       attrs={"tokens": len(emitted),
+                              "requests": len(packed)})
         if decode_tick:
             # per-tick TPOT accounting divides by tokens DELIVERED (a
             # speculative tick can emit several per request)
@@ -548,6 +663,7 @@ class ContinuousBatchScheduler:
         req.transition(RequestState.PREFILL)
         req.admitted_at = next(self._admit_counter)
         self._running[req.uid] = req
+        self._open_req_span(req, "prefill")
 
     def _pick_victim(self) -> Request:
         """Lowest priority, then most recently admitted."""
@@ -561,6 +677,7 @@ class ContinuousBatchScheduler:
         del self._running[req.uid]
         req.fed = 0
         req.preemptions += 1
+        self._close_req_span(req.uid, outcome="preempted")
         req.transition(RequestState.PREEMPTED)
         self._preempted.append(req)
         self._parked_backlog += self._work(req)
@@ -582,6 +699,7 @@ class ContinuousBatchScheduler:
             self._preempted.remove(req)
             self._parked_backlog -= self._work(req)
         req.finish_reason = reason
+        self._close_req_span(req.uid, outcome="failed", reason=reason)
         req.transition(RequestState.FAILED)
         self._live_uids.discard(req.uid)
         self._finished.append(req)
@@ -618,9 +736,13 @@ class ContinuousBatchScheduler:
                 self._parked_backlog -= self._work(req)
             if req.generated:
                 req.finish_reason = "length"
+                self._close_req_span(req.uid, outcome="finished",
+                                     reason="length")
                 req.transition(RequestState.FINISHED)
             else:
                 req.finish_reason = "kv_capacity"
+                self._close_req_span(req.uid, outcome="failed",
+                                     reason="kv_capacity")
                 req.transition(RequestState.FAILED)
             self._live_uids.discard(req.uid)
             self._finished.append(req)
@@ -673,12 +795,15 @@ class ContinuousBatchScheduler:
                 self._finish(req, reason)
             elif req.state is RequestState.PREFILL:
                 req.transition(RequestState.DECODE)
+                # prefill phase over: the span chain continues as decode
+                self._open_req_span(req, "decode")
         return emitted
 
     def _finish(self, req: Request, reason: str) -> None:
         self.engine.flush([req.uid])
         del self._running[req.uid]
         req.finish_reason = reason
+        self._close_req_span(req.uid, outcome="finished", reason=reason)
         req.transition(RequestState.FINISHED)
         self._live_uids.discard(req.uid)
         self._finished.append(req)
@@ -687,18 +812,35 @@ class ContinuousBatchScheduler:
     # ------------------------------------------------------------------ #
     # Driving loops
     # ------------------------------------------------------------------ #
-    def _export_metrics(self) -> None:
-        """serving/* scalars plus prefix-cache and fast-tick telemetry."""
-        extra = [("serving/fast_decode_ticks", float(self.fast_ticks))]
+    def telemetry(self, _snapshot: Optional[Dict[str, float]] = None
+                  ) -> Dict[str, float]:
+        """Every ``serving/*`` scalar this scheduler emits, fully
+        namespaced — the SLO snapshot plus prefix-cache and fast-tick
+        telemetry.  This is both ``_export_metrics``'s source and the
+        provider a unified :class:`MetricsRegistry` snapshots."""
+        if _snapshot is None:
+            _snapshot = self.metrics.snapshot()
+        out = {f"serving/{k}": float(v) for k, v in _snapshot.items()}
+        out["serving/fast_decode_ticks"] = float(self.fast_ticks)
         if self.speculative is not None:
-            extra.extend((f"serving/spec_{k}", v)
-                         for k, v in self.spec_stats.as_dict().items())
+            out.update((f"serving/spec_{k}", float(v))
+                       for k, v in self.spec_stats.as_dict().items())
         pc = getattr(self.engine.state_manager, "prefix_cache", None) \
             if hasattr(self.engine, "state_manager") else None
         if pc is not None:
-            extra.extend((f"serving/prefix_{k}", v)
-                         for k, v in pc.stats.as_dict().items())
-        self.metrics.export(extra=extra)
+            out.update((f"serving/prefix_{k}", float(v))
+                       for k, v in pc.stats.as_dict().items())
+        return out
+
+    def _export_metrics(self) -> None:
+        """serving/* scalars plus prefix-cache and fast-tick telemetry.
+        ONE metrics snapshot feeds both the base-name set and the extra
+        list (snapshot percentiles are not free on the export path)."""
+        snap = self.metrics.snapshot()
+        base = {f"serving/{k}" for k in snap}
+        extra = [(k, v) for k, v in self.telemetry(snap).items()
+                 if k not in base]
+        self.metrics.export(extra=extra, snapshot=snap)
 
     def run_until_idle(self, max_ticks: Optional[int] = None) -> List[Request]:
         """Step until every submitted request reaches a terminal state
@@ -820,6 +962,14 @@ class ContinuousBatchScheduler:
         self._live_uids.discard(req.uid)
         snap = req.snapshot(fed_tokens=fed)
         req.finish_reason = "handoff"
+        self._close_req_span(req.uid, outcome="handoff",
+                             fed_tokens=fed)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tr.instant("request/handoff", trace_id=req.trace_id,
+                       tid=self.trace_tid,
+                       attrs={"uid": req.uid, "fed_tokens": fed,
+                              "kv": kv_state is not None})
         req.transition(RequestState.HANDED_OFF)
         self.metrics.record_handoff(req)
         return snap, kv_state

@@ -390,6 +390,51 @@ def test_lint_repo_package_clean(dslint_repo):
 
 
 # ===================================================================== #
+# Metric-name registry lint (pass 3)
+# ===================================================================== #
+def test_metrics_lint_repo_clean(dslint_repo):
+    """Every metric-shaped string literal in the repo matches a declared
+    registry name (checked by the shared full dslint run, which scans
+    deepspeed_tpu/ + tools/ + the benches)."""
+    _rc, report = dslint_repo
+    assert not [f for f in report["new"] + report["baselined"]
+                if f["rule"] == "metric-name"]
+
+
+def test_metrics_lint_catches_typos(tmp_path):
+    from deepspeed_tpu.analysis.metrics_lint import run_metrics_lint
+
+    src = textwrap.dedent("""
+        def export(m, k):
+            m.write("serving/prefx_hits", 1)      # typo'd exact name
+            m.write("fleet/quarantined", 2)       # declared: clean
+            m.write(f"serving/spec_{k}", 3)       # declared family: clean
+            m.write(f"fleet/specc_{k}", 4)        # typo'd family prefix
+            m.write(f"resilience/{k}", 5)         # bare ns: indeterminate
+            s = "serving/* scalars and prose"     # docstring-ish: skipped
+    """)
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    findings = run_metrics_lint([str(p)])
+    assert len(findings) == 2, findings
+    assert all(f.rule == "metric-name" for f in findings)
+    msgs = " | ".join(f.message for f in findings)
+    assert "serving/prefx_hits" in msgs and "fleet/specc_" in msgs
+
+
+def test_metrics_lint_declarations_loaded():
+    """The declaring modules' import populates the default registry with
+    every namespace the stack emits."""
+    from deepspeed_tpu.analysis.metrics_lint import declared_specs
+
+    names = {s.name for s in declared_specs()}
+    assert "serving/finished" in names
+    assert "fleet/quarantined" in names
+    assert "resilience/saves" in names
+    assert "fleet/router_*" in names
+
+
+# ===================================================================== #
 # Baseline mechanics
 # ===================================================================== #
 def test_baseline_fingerprint_ignores_line_moves(tmp_path):

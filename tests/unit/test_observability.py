@@ -1,0 +1,554 @@
+"""Observability layer: tracer/ring/export mechanics, the unified
+metrics registry (declarations, providers, Prometheus exposition), the
+crash flight recorder, the CSV-writer durability fix, obs_dump's
+trace-event schema validation — and the span-continuity matrix: ONE
+``trace_id`` must span a kill→replay (two incarnations), a rolling
+restart migration, and a disaggregated prefill→decode KV handoff, while
+tracing adds zero compiles/host syncs to the steady-state decode tick.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.fleet import CircuitBreaker, ServingFleet
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
+from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.observability import (FlightRecorder, MetricsRegistry,
+                                         Tracer, list_postmortems,
+                                         load_chrome_trace,
+                                         load_postmortem, merge_events,
+                                         mint_trace_id,
+                                         write_chrome_trace,
+                                         write_postmortem)
+from deepspeed_tpu.resilience.supervisor import RestartBudget
+from deepspeed_tpu.serving import (ContinuousBatchScheduler, RequestState,
+                                   SamplingParams)
+
+CFG = LlamaConfig.tiny(dtype=jnp.float32)
+_TOOLS = pathlib.Path(__file__).resolve().parents[2] / "tools"
+GEN = 5
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(name,
+                                                  _TOOLS / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def params():
+    return LlamaForCausalLM(CFG).init(
+        jax.random.key(0), np.zeros((1, 4), np.int32))["params"]
+
+
+def _sched(params, tracer=None, registry=None, num_blocks=17):
+    cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": 32,
+                          "max_ragged_sequence_count": 4,
+                          "max_context": 48},
+        "kv_cache": {"block_size": 8, "num_blocks": num_blocks},
+    })
+    return ContinuousBatchScheduler(
+        InferenceEngineV2(RaggedLlama(CFG, 8), params, cfg),
+        tracer=tracer, registry=registry)
+
+
+def _prompts(n=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CFG.vocab_size, size=(int(k),)).tolist()
+            for k in rng.integers(8, 16, size=n)]
+
+
+def _request_tids(events, trace_id):
+    return {e["tid"] for e in events
+            if (e.get("args") or {}).get("trace_id") == trace_id
+            and e["name"].startswith("request/")}
+
+
+# --------------------------------------------------------------------- #
+# Tracer mechanics
+# --------------------------------------------------------------------- #
+def test_tracer_span_nesting_and_export():
+    tr = Tracer(tid="t0")
+    t = mint_trace_id()
+    with tr.span("outer", trace_id=t) as h:
+        with tr.span("inner", trace_id=t, parent=h.span_id):
+            pass
+        tr.instant("mark", trace_id=t, parent=h.span_id,
+                   attrs={"k": 1})
+    evs = tr.export_events()
+    by_name = {e["name"]: e for e in evs}
+    assert by_name["inner"]["args"]["parent"] == \
+        by_name["outer"]["args"]["span_id"]
+    assert by_name["mark"]["ph"] == "i" and by_name["mark"]["args"]["k"] == 1
+    assert by_name["outer"]["ph"] == "X" and by_name["outer"]["dur"] >= 0
+    # inner closed before outer: strictly contained
+    assert by_name["inner"]["ts"] >= by_name["outer"]["ts"]
+
+
+def test_tracer_ring_is_bounded():
+    tr = Tracer(capacity=4)
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr) == 4 and tr.dropped == 6
+    names = [r["name"] for r in tr.records()]
+    assert names == ["s6", "s7", "s8", "s9"]   # oldest evicted first
+    assert [r["name"] for r in tr.records(tail=2)] == ["s8", "s9"]
+
+
+def test_disabled_tracer_records_nothing():
+    tr = Tracer(enabled=False)
+    with tr.span("x"):
+        tr.instant("y")
+    assert len(tr) == 0 and not tr.open_spans()
+
+
+def test_open_span_exports_unfinished():
+    tr = Tracer()
+    tr.start("dangling", trace_id="abc")
+    evs = tr.export_events()
+    assert evs[0]["name"] == "dangling"
+    assert evs[0]["args"]["unfinished"] is True
+    assert tr.export_events(include_open=False) == []
+
+
+def test_span_ids_unique_across_tracers():
+    ids = set()
+    for _ in range(3):
+        tr = Tracer()
+        for _ in range(50):
+            with tr.span("s"):
+                pass
+        ids.update(e["args"]["span_id"] for e in tr.export_events())
+    assert len(ids) == 150
+
+
+def test_chrome_trace_roundtrip_and_tid_metadata(tmp_path):
+    tr_a, tr_b = Tracer(tid="replica0#0"), Tracer(tid="replica0#1")
+    t = mint_trace_id()
+    with tr_a.span("a", trace_id=t):
+        pass
+    with tr_b.span("b", trace_id=t):
+        pass
+    path = str(tmp_path / "nested" / "trace.json")
+    write_chrome_trace(path, merge_events(tr_a.export_events(),
+                                          tr_b.export_events()))
+    evs = load_chrome_trace(path)
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta} == {"replica0#0",
+                                                "replica0#1"}
+    # Perfetto wants integer tids; the string labels live in metadata
+    assert all(isinstance(e["tid"], int) for e in evs)
+
+
+# --------------------------------------------------------------------- #
+# MetricsRegistry
+# --------------------------------------------------------------------- #
+def test_registry_declarations_and_lookup():
+    reg = MetricsRegistry(isolated=True)
+    reg.counter("serving/finished", help="done requests")
+    reg.histogram("serving/p50_*")
+    assert reg.lookup("serving/finished").kind == "counter"
+    assert reg.lookup("serving/p50_ttft_s").kind == "histogram"
+    assert reg.lookup("serving/nope") is None
+    # exact beats pattern; longest pattern wins
+    reg.gauge("serving/p50_special")
+    assert reg.lookup("serving/p50_special").kind == "gauge"
+    with pytest.raises(ValueError, match="re-declared"):
+        reg.gauge("serving/finished")
+    with pytest.raises(ValueError, match="kind"):
+        reg.declare("serving/x", kind="bogus")
+
+
+def test_registry_providers_snapshot_and_unknowns():
+    reg = MetricsRegistry(isolated=True)
+    reg.counter("serving/finished")
+    reg.register_provider("a", lambda: {"serving/finished": 2.0,
+                                        "serving/typo": 1.0})
+    snap = reg.snapshot()
+    assert snap["serving/finished"] == 2.0
+    assert snap["serving/typo"] == 1.0          # kept, never dropped
+    assert reg.unknown_names == {"serving/typo"}
+    # a raising provider is skipped but leaves a marker
+    reg.register_provider("bad", lambda: 1 / 0)
+    snap = reg.snapshot()
+    assert snap["registry/provider_error_bad"] == 1.0
+    reg.unregister_provider("bad")
+    assert "registry/provider_error_bad" not in reg.snapshot()
+
+
+def test_registry_prometheus_exposition():
+    reg = MetricsRegistry(isolated=True)
+    reg.counter("serving/finished", help="done requests")
+    reg.histogram("serving/p50_*")
+    reg.register_provider("a", lambda: {"serving/finished": 3.0,
+                                        "serving/p50_ttft_s": 0.25})
+    text = reg.to_prometheus()
+    assert "# HELP serving_finished done requests" in text
+    assert "# TYPE serving_finished counter" in text
+    assert "serving_finished 3" in text
+    # histogram-kind families render as gauges (pre-aggregated p50/p95)
+    assert "# TYPE serving_p50_ttft_s gauge" in text
+    assert text.endswith("\n")
+
+
+def test_registry_export_wallclock_events():
+    class FakeMonitor:
+        enabled = True
+
+        def __init__(self):
+            self.events = []
+
+        def write_events(self, events):
+            self.events.extend(events)
+
+    reg = MetricsRegistry(isolated=True)
+    reg.counter("serving/finished")
+    reg.register_provider("a", lambda: {"serving/finished": 1.0})
+    mon = FakeMonitor()
+    events = reg.export(monitor=mon)
+    assert mon.events == events
+    name, value, x = events[0]
+    assert name == "serving/finished" and value == 1.0
+    assert isinstance(x, float) and x > 1e9     # wall-clock seconds
+
+
+def test_global_declarations_cover_live_serving_snapshot(params):
+    """Runtime complement of the metric-name lint: a real scheduler
+    run's full telemetry must hit only declared names."""
+    reg = MetricsRegistry()
+    sched = _sched(params, registry=reg)
+    for p in _prompts():
+        sched.submit(p, sampling=SamplingParams(greedy=True,
+                                                max_new_tokens=GEN))
+    sched.run_until_idle()
+    reg.snapshot()
+    assert not reg.unknown_names, reg.unknown_names
+
+
+# --------------------------------------------------------------------- #
+# Flight recorder
+# --------------------------------------------------------------------- #
+def test_postmortem_roundtrip(tmp_path):
+    breaker = CircuitBreaker(failure_threshold=1)
+    breaker.record_failure()
+    budget = RestartBudget(max_restarts=4, window_s=60.0)
+    tr = Tracer(tid="replica0#0")
+    with tr.span("tick", trace_id="t1"):
+        pass
+    path = write_postmortem(
+        str(tmp_path / "pm" / "0.replica0.crash.json"),
+        reason="crash", replica="replica0", blamed_uids=[5, 3],
+        convicted=5, suspects=[3], breaker=breaker, budget=budget,
+        spans=tr.export_events())
+    pm = load_postmortem(path)
+    assert pm["reason"] == "crash" and pm["replica"] == "replica0"
+    assert pm["blamed_uids"] == [3, 5] and pm["convicted_uid"] == 5
+    assert pm["breaker"]["state"] == "open"
+    assert pm["budget"]["max_restarts"] == 4
+    assert pm["spans"][0]["name"] == "tick"
+    with pytest.raises(ValueError, match="postmortem"):
+        bogus = tmp_path / "x.json"
+        bogus.write_text("{}")
+        load_postmortem(str(bogus))
+
+
+def test_flight_recorder_flush_and_torn_read(tmp_path):
+    tr = Tracer(tid="w0")
+    fl = str(tmp_path / "flight.0.json")
+    rec = FlightRecorder(tr, fl, flush_every=2, last_n=8)
+    with tr.span("s1"):
+        pass
+    rec.tick()
+    assert not os.path.exists(fl)      # below flush_every
+    rec.tick()
+    spans = FlightRecorder.read_flight(fl)
+    assert [s["name"] for s in spans] == ["s1"]
+    # a torn file reads as empty, never raises
+    with open(fl, "w") as f:
+        f.write('{"schema": "ds-flight-v1", "spans": [')
+    assert FlightRecorder.read_flight(fl) == []
+    assert FlightRecorder.read_flight(str(tmp_path / "missing.json")) == []
+
+
+def test_list_postmortems_sorted(tmp_path):
+    d = str(tmp_path)
+    for i in range(3):
+        write_postmortem(os.path.join(d, f"{i}.r.crash.json"),
+                         reason="crash", replica="r")
+        time.sleep(0.01)
+    got = [os.path.basename(p) for p in list_postmortems(d)]
+    assert got == ["0.r.crash.json", "1.r.crash.json", "2.r.crash.json"]
+
+
+# --------------------------------------------------------------------- #
+# CSV monitor durability (satellite: torn-write survival)
+# --------------------------------------------------------------------- #
+def _csv_monitor(tmp_path):
+    from deepspeed_tpu.monitor.monitor import CSVMonitor
+
+    class Cfg:
+        enabled = True
+        output_path = str(tmp_path)
+        job_name = "job"
+
+    return CSVMonitor(Cfg())
+
+
+def test_csv_monitor_recreates_parent_dirs_and_fsyncs(tmp_path):
+    mon = _csv_monitor(tmp_path)
+    mon.write_events([("serving/finished", 1.0, 0.5)])
+    # simulate a cleanup between writes: the writer must recreate, not
+    # silently drop the series
+    import shutil
+
+    shutil.rmtree(mon.output_path)
+    mon.write_events([("serving/finished", 2.0, 1.5),
+                      ("serving/finished", 3.0, 2.5)])
+    from deepspeed_tpu.monitor.monitor import read_csv_series
+
+    rows = read_csv_series(os.path.join(mon.output_path,
+                                        "serving_finished.csv"))
+    assert rows == [(1.5, 2.0), (2.5, 3.0)]
+
+
+def test_csv_series_survives_torn_final_line(tmp_path):
+    mon = _csv_monitor(tmp_path)
+    for i in range(3):
+        mon.write_events([("serving/goodput_tokens_per_s",
+                           float(i), float(i))])
+    fname = os.path.join(mon.output_path,
+                         "serving_goodput_tokens_per_s.csv")
+    with open(fname, "a", newline="") as f:
+        f.write("3.0,4")               # SIGKILL mid-row: torn tail
+        f.flush()
+    # ...but what landed before the kill is intact and parseable
+    from deepspeed_tpu.monitor.monitor import read_csv_series
+
+    rows = read_csv_series(fname)
+    assert rows[:3] == [(0.0, 0.0), (1.0, 1.0), (2.0, 2.0)]
+
+
+# --------------------------------------------------------------------- #
+# obs_dump schema validation
+# --------------------------------------------------------------------- #
+def test_validate_trace_accepts_tracer_export():
+    obs_dump = _load_tool("obs_dump")
+    tr = Tracer()
+    t = mint_trace_id()
+    with tr.span("tick", trace_id=t) as h:
+        with tr.span("pack", trace_id=t, parent=h.span_id):
+            pass
+    assert obs_dump.validate_trace(tr.export_events()) == []
+
+
+def test_validate_trace_flags_schema_violations():
+    obs_dump = _load_tool("obs_dump")
+    base = {"ph": "X", "ts": 1.0, "dur": 1.0, "pid": 1, "tid": "t"}
+
+    def ev(name, trace_id="t1", span_id=None, parent=None, **kw):
+        return {**base, "name": name, **kw,
+                "args": {"trace_id": trace_id, "span_id": span_id,
+                         "parent": parent}}
+
+    # orphan parent
+    probs = obs_dump.validate_trace([ev("a", span_id="s1",
+                                        parent="missing")])
+    assert any("does not exist" in p for p in probs)
+    # missing trace id
+    probs = obs_dump.validate_trace([ev("a", trace_id=None,
+                                        span_id="s1")])
+    assert any("trace_id" in p for p in probs)
+    # duplicate span ids
+    probs = obs_dump.validate_trace([ev("a", span_id="s1"),
+                                     ev("b", span_id="s1")])
+    assert any("duplicate" in p for p in probs)
+    # B without E (and the fixed pair passes)
+    b = {**ev("a", span_id="s1"), "ph": "B"}
+    assert any("without matching E" in p
+               for p in obs_dump.validate_trace([b]))
+    e = {**ev("a", span_id="s1"), "ph": "E"}
+    assert obs_dump.validate_trace([b, e]) == []
+    # cross-trace parent
+    probs = obs_dump.validate_trace([
+        ev("a", trace_id="t1", span_id="s1"),
+        ev("b", trace_id="t2", span_id="s2", parent="s1")])
+    assert any("different trace" in p for p in probs)
+
+
+def test_obs_dump_tool_tiny_run(tmp_path):
+    obs_dump = _load_tool("obs_dump")
+    summary = obs_dump.run_traced_sample(str(tmp_path), n_requests=3)
+    assert summary["obs_dump"] == "ok" and summary["schema_problems"] == 0
+    # the written artifacts load and validate standalone
+    events = load_chrome_trace(summary["trace_path"])
+    assert obs_dump.validate_trace(events) == []
+    prom = open(summary["prom_path"]).read()
+    assert "# TYPE serving_finished counter" in prom
+
+
+# --------------------------------------------------------------------- #
+# Span continuity across incarnations / pools
+# --------------------------------------------------------------------- #
+def test_trace_continuity_kill_replay_two_incarnations(params, tmp_path):
+    """ONE trace_id spans a replica kill: spans from incarnation #0 and
+    the respawn's #1 connect, and the death postmortem names the blamed
+    uids with the dead replica's recent spans attached."""
+    fleet = ServingFleet(lambda name: _sched(params), replicas=2,
+                         postmortem_dir=str(tmp_path))
+    samp = SamplingParams(greedy=True, max_new_tokens=8)
+    frs = [fleet.submit(p, sampling=samp) for p in _prompts()]
+    for _ in range(3):
+        fleet.step()
+    victim = next(fr.replica for fr in frs if not fr.done)
+    fleet.kill_replica(victim)
+    fleet.run_until_idle(max_ticks=500)
+    assert all(fr.state == "finished" for fr in frs)
+    events = fleet.export_trace()
+    replayed = [fr for fr in frs if fr.replays > 0]
+    assert replayed, "kill landed on an idle replica?"
+    for fr in replayed:
+        tids = _request_tids(events, fr.trace_id)
+        assert len(tids) >= 2, (fr.uid, tids)   # both incarnations
+    pms = [load_postmortem(p) for p in list_postmortems(str(tmp_path))]
+    assert pms and pms[0]["reason"] == "killed"
+    assert set(pms[0]["blamed_uids"]) == {fr.uid for fr in replayed}
+    assert pms[0]["spans"], "no flight-recorder spans in postmortem"
+    assert all(str(s["tid"]).startswith(victim)
+               for s in pms[0]["spans"])
+
+
+def test_trace_continuity_rolling_restart(params):
+    fleet = ServingFleet(lambda name: _sched(params), replicas=2)
+    samp = SamplingParams(greedy=True, max_new_tokens=12)
+    frs = [fleet.submit(p, sampling=samp) for p in _prompts(n=2)]
+    for _ in range(3):
+        fleet.step()
+    fleet.rolling_restart(drain_deadline_s=0.0)
+    fleet.run_until_idle(max_ticks=500)
+    assert all(fr.state == "finished" for fr in frs)
+    events = fleet.export_trace()
+    migrated = [fr for fr in frs if fr.handoffs > 0]
+    assert migrated, "nothing migrated during the restart?"
+    for fr in migrated:
+        tids = _request_tids(events, fr.trace_id)
+        # old incarnation's spans + the continuation's (post-upgrade
+        # incarnation or a sibling replica)
+        assert len(tids) >= 2, (fr.uid, tids)
+
+
+def test_trace_continuity_disaggregated_handoff(params):
+    """The prefill span and the decode span of one request live on
+    DIFFERENT pools but share the trace: the KV handoff is visible as
+    one connected timeline."""
+    fleet = ServingFleet(lambda name: _sched(params),
+                         prefill_replicas=1, decode_replicas=1)
+    samp = SamplingParams(greedy=True, max_new_tokens=6)
+    frs = [fleet.submit(p, sampling=samp) for p in _prompts(n=2)]
+    fleet.run_until_idle(max_ticks=500)
+    assert all(fr.state == "finished" for fr in frs)
+    events = fleet.export_trace()
+    for fr in frs:
+        assert fr.handoffs >= 1
+        tids = _request_tids(events, fr.trace_id)
+        assert any(t.startswith("prefill") for t in tids), tids
+        assert any(t.startswith("decode") for t in tids), tids
+        # the handoff instant carries the KV evidence
+        hand = [e for e in events
+                if e["name"] == "request/handoff"
+                and (e.get("args") or {}).get("trace_id") == fr.trace_id]
+        assert hand and hand[0]["args"]["kv"] is True
+
+
+def test_kill_then_handoff_single_connected_trace(params, tmp_path):
+    """The acceptance-criterion composition: disaggregated fleet, a
+    mid-decode replica kill — one request's trace still validates as
+    ONE connected timeline with spans from both pools and both
+    incarnations, loadable by obs_dump."""
+    obs_dump = _load_tool("obs_dump")
+    fleet = ServingFleet(lambda name: _sched(params),
+                         prefill_replicas=1, decode_replicas=2)
+    samp = SamplingParams(greedy=True, max_new_tokens=12)
+    frs = [fleet.submit(p, sampling=samp) for p in _prompts()]
+    deadline = time.monotonic() + 60
+    victim = None
+    while time.monotonic() < deadline and victim is None:
+        fleet.step()
+        for fr in frs:
+            if not fr.done and fr.replica \
+                    and fr.replica.startswith("decode") \
+                    and 1 <= len(fr.tokens) <= 6:
+                victim = fr.replica
+                break
+    assert victim is not None, "never caught a mid-decode request"
+    fleet.kill_replica(victim)
+    fleet.run_until_idle(max_ticks=800)
+    assert all(fr.state == "finished" for fr in frs)
+    trace_path = str(tmp_path / "trace.json")
+    events = fleet.export_trace(trace_path)
+    assert obs_dump.validate_trace(events) == []
+    assert obs_dump.validate_trace(load_chrome_trace(trace_path)) == []
+    killed = [fr for fr in frs if fr.replays > 0]
+    assert killed, "the kill lost no one?"
+    fr = killed[0]
+    tids = _request_tids(events, fr.trace_id)
+    assert any(t.startswith("prefill") for t in tids), tids
+    assert any(t.startswith("decode") for t in tids), tids
+    assert len(tids) >= 3, tids        # both pools AND both incarnations
+
+
+# --------------------------------------------------------------------- #
+# Tracing on the steady-state decode tick (guarded)
+# --------------------------------------------------------------------- #
+def test_traced_decode_tick_recompile_and_sync_free():
+    """The tracer-overhead satellite: the decode fast tick under
+    TraceGuard with tracing enabled builds 0 executables and adds 0
+    host syncs vs the untraced guard block."""
+    snap = _load_tool("serving_smoke").run_decode_guard()
+    assert snap["decode_guard"] == "ok"
+    assert snap["traced_compiles"] == 0
+    assert snap["traced_host_syncs"] == snap["host_syncs"]
+    assert snap["traced_spans"] >= snap["guarded_ticks"]
+
+
+def test_flight_recorder_smoke_tool():
+    snap = _load_tool("serving_smoke").run_flight_recorder_smoke()
+    assert snap["flight_recorder_smoke"] == "ok"
+    assert snap["postmortem_deaths"] >= 1
+    assert snap["poison_incarnations"] >= 2
+
+
+# --------------------------------------------------------------------- #
+# Worker-side black box (no subprocess: the recorder API directly)
+# --------------------------------------------------------------------- #
+def test_worker_flight_paths_are_per_incarnation(tmp_path):
+    from deepspeed_tpu.fleet.worker import flight_path
+
+    a = flight_path(str(tmp_path), 0)
+    b = flight_path(str(tmp_path), 1)
+    assert a != b and a.endswith("flight.0.json")
+
+
+def test_snapshot_carries_trace_id_through_json():
+    from deepspeed_tpu.serving import Request
+
+    req = Request(uid=7, prompt=[1, 2, 3], trace_id="deadbeef00112233")
+    req.generated = [4]
+    from deepspeed_tpu.serving import RequestSnapshot
+
+    snap = RequestSnapshot.from_json(req.snapshot().to_json())
+    assert snap.trace_id == "deadbeef00112233"
+    assert snap.to_request().trace_id == "deadbeef00112233"

@@ -87,11 +87,12 @@ class _FakeScheduler:
         self._uid = 100
 
     def submit(self, prompt, sampling=None, priority=0, deadline_s=None,
-               on_token=None, uid=None):
+               on_token=None, uid=None, trace_id=None):
         self._uid += 1
         req = self._Request(uid=uid or self._uid, prompt=list(prompt),
                             sampling=sampling or SamplingParams(),
-                            priority=priority, deadline_s=deadline_s)
+                            priority=priority, deadline_s=deadline_s,
+                            trace_id=trace_id)
         self._queued.append(req)
         return req
 

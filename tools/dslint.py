@@ -48,14 +48,28 @@ def run(argv=None) -> int:
                     help="skip the kernel contract checker")
     ap.add_argument("--skip-jit", action="store_true",
                     help="skip the jit-safety AST pass")
+    ap.add_argument("--skip-metrics", action="store_true",
+                    help="skip the metric-name registry cross-check")
     args = ap.parse_args(argv)
 
     findings = []
+    paths = args.paths or [os.path.join(repo_root(), "deepspeed_tpu")]
     if not args.skip_jit:
         from deepspeed_tpu.analysis.jit_lint import run_jit_lint
 
-        paths = args.paths or [os.path.join(repo_root(), "deepspeed_tpu")]
         findings.extend(run_jit_lint(paths))
+    if not args.skip_metrics:
+        from deepspeed_tpu.analysis.metrics_lint import run_metrics_lint
+
+        # default scope widens beyond the package: the tools/benches
+        # also name metrics, and a typo there misreads a real series
+        mpaths = args.paths or [
+            os.path.join(repo_root(), "deepspeed_tpu"),
+            os.path.join(repo_root(), "tools"),
+            os.path.join(repo_root(), "bench_serving.py"),
+            os.path.join(repo_root(), "bench.py"),
+        ]
+        findings.extend(run_metrics_lint(mpaths))
     if not args.skip_pallas:
         from deepspeed_tpu.analysis.pallas_lint import run_pallas_lint
 

@@ -101,8 +101,11 @@ def _scheduler_from_checkpoint(ckpt_dir: str):
 def run_worker(spool_dir: str, ckpt_dir: str) -> int:
     from deepspeed_tpu.fleet import run_replica_worker
 
+    # aggressive flight flushing: the poison variant kills workers
+    # within a few ticks, and the postmortem wants their span rings
     return run_replica_worker(spool_dir,
-                              _scheduler_from_checkpoint(ckpt_dir))
+                              _scheduler_from_checkpoint(ckpt_dir),
+                              flight_flush_every=4)
 
 
 def _write_checkpoint(base: str) -> str:
@@ -289,11 +292,35 @@ def run_poison_variant(base: str, gold) -> dict:
                 (fr.uid, fr.state, fr.finish_reason)
             assert fr.tokens == gold[i], \
                 f"innocent {fr.uid} diverged (replays={fr.replays})"
+        # flight recorder: every worker death left a postmortem naming
+        # the blamed uids, and the conviction postmortem names the
+        # convicted uid — the black box survives SIGKILLed workers
+        from deepspeed_tpu.observability import (list_postmortems,
+                                                 load_postmortem)
+
+        pms = [load_postmortem(p)
+               for p in list_postmortems(fe.postmortem_dir)]
+        assert pms, f"no postmortems under {fe.postmortem_dir}"
+        deaths = [p for p in pms if p["reason"] == "crash"]
+        assert deaths and all(poison_uid in p["blamed_uids"]
+                              for p in deaths), deaths
+        conv = [p for p in pms if p["reason"] == "quarantine"]
+        assert conv and conv[-1]["convicted_uid"] == poison_uid, conv
+        # the dead workers' flight files made it into the postmortems
+        # (the first death can race the worker's first periodic flush,
+        # so require evidence on at least one death, not all — with
+        # flight_flush_every=4 and 32-token generations a worker always
+        # flushes before the blame pipeline's later kills land)
+        spans_recovered = sum(len(p["spans"]) for p in deaths)
+        assert spans_recovered > 0, \
+            "no flight-recorder spans recovered from any worker death"
         return {
             "poison_respawns": respawns,
             "poison_deaths_journaled": len(fe.blame.deaths),
             "poison_quarantine_s": round(quarantine_s, 2),
             "poison_innocent_replays": sum(fr.replays for fr in frs),
+            "poison_postmortems": len(pms),
+            "poison_postmortem_spans": spans_recovered,
         }
     finally:
         fe.stop(timeout_s=60)

@@ -90,7 +90,12 @@ def run_decode_guard(n_ticks: int = 4, warm_ticks: int = 2,
     ``warm_ticks`` decode ticks, ``n_ticks`` further ticks must build
     ZERO new executables (dslint TraceGuard; the implicit device→host
     transfer guard is armed too — vacuous on the CPU backend, teeth on
-    a real TPU). Raises TraceGuardError on any recompile."""
+    a real TPU). Raises TraceGuardError on any recompile.
+
+    A second guard block then runs the SAME ticks with the observability
+    tracer attached: tick/phase/request spans are pure host-side ring
+    writes, so tracing must not add a single compile or host sync to
+    the steady-state decode path."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -118,8 +123,9 @@ def run_decode_guard(n_ticks: int = 4, warm_ticks: int = 2,
     sched = ContinuousBatchScheduler(engine)
 
     rng = np.random.default_rng(seed)
+    # budget covers the untraced AND traced guard blocks with slack
     sampling = SamplingParams(greedy=True,
-                              max_new_tokens=warm_ticks + n_ticks + 4)
+                              max_new_tokens=warm_ticks + 2 * n_ticks + 6)
     for _ in range(2):
         sched.submit(rng.integers(0, cfg.vocab_size, size=(4,)).tolist(),
                      sampling=sampling)
@@ -140,9 +146,28 @@ def run_decode_guard(n_ticks: int = 4, warm_ticks: int = 2,
         for _ in range(n_ticks):
             emitted = sched.step()
             assert emitted, "decode tick emitted no tokens"
+    # same ticks, tracing ON: spans are host-side ring writes and must
+    # stay invisible to the compile/sync guards
+    from deepspeed_tpu.observability import Tracer
+
+    tracer = Tracer(tid="decode_guard")
+    sched.attach_tracer(tracer)
+    with TraceGuard(max_compiles=0, d2h="disallow",
+                    label="serving decode tick (traced)") as tg2:
+        for _ in range(n_ticks):
+            emitted = sched.step()
+            assert emitted, "traced decode tick emitted no tokens"
+    traced_spans = len(tracer.export_events())
+    assert traced_spans >= n_ticks, traced_spans
+    assert all(e["tid"] == "decode_guard"
+               for e in tracer.export_events())
+    sched.attach_tracer(None)
     sched.run_until_idle()
     return {"decode_guard": "ok", "guarded_ticks": n_ticks,
-            "compiles": tg.compiles, "host_syncs": tg.host_syncs}
+            "compiles": tg.compiles, "host_syncs": tg.host_syncs,
+            "traced_compiles": tg2.compiles,
+            "traced_host_syncs": tg2.host_syncs,
+            "traced_spans": traced_spans}
 
 
 def run_prefix_router_smoke(seed: int = 2) -> dict:
@@ -296,12 +321,92 @@ def run_speculative_smoke(seed: int = 0) -> dict:
             "spec_ticks": st.ticks}
 
 
+def run_flight_recorder_smoke(seed: int = 3) -> dict:
+    """Flight-recorder smoke: a 2-replica in-process fleet with a poison
+    request chaos-armed to crash any replica that batches it.  Asserts
+    the defense pipeline convicts AND leaves the postmortem evidence:
+    every replica death dumped a file naming the blamed uids and recent
+    tick spans, and the conviction postmortem names the convicted uid."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.fleet import ServingFleet
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.observability import (list_postmortems,
+                                             load_postmortem)
+    from deepspeed_tpu.resilience import chaos
+    from deepspeed_tpu.serving import (ContinuousBatchScheduler,
+                                       SamplingParams)
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.key(0), np.zeros((1, 4), np.int32))["params"]
+
+    def make_sched(name):
+        eng_cfg = RaggedInferenceEngineConfig.from_dict({
+            "state_manager": {"max_ragged_batch_size": 32,
+                              "max_ragged_sequence_count": 4,
+                              "max_context": 48},
+            "kv_cache": {"block_size": 8, "num_blocks": 17},
+        })
+        return ContinuousBatchScheduler(
+            InferenceEngineV2(RaggedLlama(cfg, 8), params, eng_cfg))
+
+    pm_dir = tempfile.mkdtemp(prefix="serving_postmortem_")
+    fleet = ServingFleet(make_sched, replicas=2, postmortem_dir=pm_dir)
+    rng = np.random.default_rng(seed)
+    samp = SamplingParams(greedy=True, max_new_tokens=6)
+    frs = [fleet.submit(
+        rng.integers(0, cfg.vocab_size, size=(10,)).tolist(),
+        sampling=samp) for _ in range(3)]
+    poison = fleet.submit(list(range(1, 11)), sampling=samp)
+    chaos.arm("poison_request", "raise", key=str(poison.uid), count=0)
+    try:
+        fleet.run_until_idle(max_ticks=500)
+    finally:
+        chaos.disarm("poison_request")
+    assert poison.state == "failed" \
+        and poison.finish_reason == "quarantined", \
+        (poison.state, poison.finish_reason)
+    assert all(fr.state == "finished" for fr in frs), \
+        [(fr.uid, fr.state) for fr in frs]
+    pms = [load_postmortem(p) for p in list_postmortems(pm_dir)]
+    assert pms, "no postmortem files written"
+    deaths = [p for p in pms if p["reason"] != "quarantine"]
+    assert deaths and all(poison.uid in p["blamed_uids"]
+                          for p in deaths), deaths
+    # the death postmortems carry the dead replica's recent tick spans
+    assert any(p["spans"] for p in deaths), \
+        "no flight-recorder spans in any death postmortem"
+    conv = [p for p in pms if p["reason"] == "quarantine"]
+    assert conv and conv[-1]["convicted_uid"] == poison.uid, conv
+    # the whole incident is one connected trace: the poison's spans
+    # from every incarnation share its trace_id
+    evs = fleet.export_trace()
+    tids = {e["tid"] for e in evs
+            if (e.get("args") or {}).get("trace_id") == poison.trace_id
+            and e["name"].startswith("request/")}
+    assert len(tids) >= 2, tids
+    return {"flight_recorder_smoke": "ok",
+            "postmortems": len(pms),
+            "postmortem_deaths": len(deaths),
+            "convicted_uid": int(conv[-1]["convicted_uid"]),
+            "poison_incarnations": len(tids)}
+
+
 def main() -> int:
     t0 = time.monotonic()
     snap = run_smoke()
     snap.update(run_decode_guard())
     snap.update(run_prefix_router_smoke())
     snap.update(run_speculative_smoke())
+    snap.update(run_flight_recorder_smoke())
     snap["wall_s"] = round(time.monotonic() - t0, 2)
     print(json.dumps({"serving_smoke": "ok", **snap}))
     return 0
