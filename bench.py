@@ -62,21 +62,22 @@ def peak_flops_per_chip() -> float:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:
         pass
-    if "v5 lite" in kind or "v5e" in kind:
-        return 197e12
-    if "v5p" in kind or "v5" in kind:
-        return 459e12
-    if "v4" in kind:
-        return 275e12
-    if "v6" in kind or "trillium" in kind:
-        return 918e12
-    return 197e12  # conservative default (cpu-sim prints are meaningless anyway)
+    # the per-chip NUMBERS live in one table (observability.roofline
+    # CHIP_SPECS — perf_report reads the same one); an unknown or CPU
+    # kind keeps the conservative v5e default so cpu-fallback records
+    # stay comparable with prior rounds (cpu prints are meaningless)
+    from deepspeed_tpu.observability.roofline import chip_specs
+
+    return chip_specs("" if "cpu" in kind else kind)[0]
 
 
 def _measure(heads: int, micro_batch: int, seq: int,
-             attention_layout: str = "bshd"):
+             attention_layout: str = "bshd", ledger_out: dict = None):
     """One training-throughput measurement at the given head geometry.
-    Returns (tokens/s/chip, mfu, loss, step_ms, n_params, n_dev)."""
+    Returns (tokens/s/chip, mfu, loss, step_ms, n_params, n_dev).
+    With ``ledger_out`` (a dict), the engine's compiled train programs'
+    HLO memory/cost analysis is recorded into it (explicit
+    ``unavailable`` on failure) — the BENCH JSON's memory evidence."""
     import jax
     import jax.numpy as jnp
 
@@ -132,6 +133,19 @@ def _measure(heads: int, micro_batch: int, seq: int,
     dt = time.perf_counter() - t0
 
     tokens_per_sec_per_chip = batch * seq * iters / dt / n_dev
+
+    if ledger_out is not None:
+        from deepspeed_tpu.observability.memory import unavailable_entry
+
+        # compile-time HLO memory evidence for the program just timed
+        # (re-lowered from recorded shapes; the persistent compilation
+        # cache makes it a lookup, not a second cold compile)
+        try:
+            ledger_out.update(
+                engine.capture_memory_ledger().to_json()["entries"])
+        except Exception as e:  # noqa: BLE001 — absence is a record
+            ledger_out["train_step"] = unavailable_entry(
+                f"{type(e).__name__}: {e}")
 
     from deepspeed_tpu.utils.tensors import tree_num_params
 
@@ -247,10 +261,11 @@ def main():
     import os
 
     headline_layout = os.environ.get("DS_ATTENTION_LAYOUT", "bshd")
+    mem_entries = {}
     with _stage("bench/headline_train"):
         tok_s, mfu, loss, step_ms, n_params, n_dev = _measure(
             heads=HEADLINE_HEADS, micro_batch=HEADLINE_MB, seq=seq,
-            attention_layout=headline_layout)
+            attention_layout=headline_layout, ledger_out=mem_entries)
 
     # on-chip Pallas kernel selftest (every kernel vs its jnp reference,
     # compiled — not interpret mode), time-permitting
@@ -310,6 +325,31 @@ def main():
         else:
             folded_geom = {"note": "skipped: bench time budget"}
 
+    # --- HLO memory ledger: the 7B ZeRO-3 VIRTUAL-MESH compile evidence
+    # (ROADMAP item 3) — abstract lowering in a CPU subprocess (no
+    # weights materialised, the parent's TPU backend untouched), bounded
+    # by the remaining bench budget.  The BENCH JSON always carries the
+    # entry: real memory_analysis numbers, or an explicit unavailable
+    # record naming why (timeout / budget / old-jax mesh APIs).
+    _7b_key = "virtual_mesh/7b_zero3"
+    from deepspeed_tpu.observability.memory import unavailable_entry
+    try:
+        from deepspeed_tpu.observability.memory import (
+            virtual_mesh_probe_subprocess)
+
+        budget_left = 560 - elapsed()
+        if budget_left > 60:
+            with _stage("bench/memory_ledger_7b_zero3"):
+                mem_entries[_7b_key] = virtual_mesh_probe_subprocess(
+                    "7b_zero3", timeout_s=min(240.0, budget_left))
+        else:
+            mem_entries[_7b_key] = unavailable_entry(
+                "skipped: bench time budget")
+    except Exception as e:  # noqa: BLE001 — absence is a record
+        mem_entries[_7b_key] = unavailable_entry(
+            f"{type(e).__name__}: {e}")
+    print(f"# memory ledger done at {elapsed():.0f}s", file=sys.stderr)
+
     if tracer is not None:
         from deepspeed_tpu.observability import write_chrome_trace
 
@@ -330,6 +370,13 @@ def main():
             "head_dim": 768 // HEADLINE_HEADS,
             "micro_batch": HEADLINE_MB,
             "attention_layout": headline_layout,
+            # geometry constants so perf_report's cost model needs no
+            # out-of-band knowledge of the bench config
+            "geometry": {"hidden": 768, "layers": 12,
+                         "intermediate": 2048, "vocab": 32000,
+                         "dtype": "bfloat16"},
+            "memory_ledger": {"schema": "ds-memory-ledger-v1",
+                              "entries": mem_entries},
             **({"folded_attention": folded_geom} if folded_geom else {}),
             **({"tpu_geometry": tpu_geom} if tpu_geom else {}),
             "serving_7b": serving_7b,

@@ -40,8 +40,10 @@ import numpy as np
 
 
 def hbm_bandwidth_bytes_per_s() -> float:
-    """Single source for the chip's HBM bandwidth (used by every
-    roofline here)."""
+    """The chip's HBM bandwidth for every roofline here — the NUMBERS
+    live in observability.roofline's CHIP_SPECS (perf_report reads the
+    same table).  Unknown/CPU kinds keep the conservative v5e default
+    so cpu-fallback records stay comparable with prior rounds."""
     import jax
 
     kind = ""
@@ -49,15 +51,9 @@ def hbm_bandwidth_bytes_per_s() -> float:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:  # noqa: BLE001
         pass
-    if "v5 lite" in kind or "v5e" in kind:
-        return 819e9
-    if "v5p" in kind or "v5" in kind:
-        return 2765e9
-    if "v4" in kind:
-        return 1228e9
-    if "v6" in kind or "trillium" in kind:
-        return 1640e9
-    return 819e9  # conservative default
+    from deepspeed_tpu.observability.roofline import chip_specs
+
+    return chip_specs("" if "cpu" in kind else kind)[1]
 
 
 def main():
@@ -495,6 +491,17 @@ def measure_scheduler(n_requests: int = 32, rate_rps: float = 16.0,
                    for l in jax.tree_util.tree_leaves(params))
     roofline_tok_s = clients * hbm_bandwidth_bytes_per_s() / (n_params * 2)
 
+    # compile-time HLO memory ledger for the decode program (abstract
+    # re-lowering — the live cache is never touched), so the BENCH JSON
+    # carries the memory evidence perf_report renders
+    from deepspeed_tpu.observability.memory import unavailable_entry
+    try:
+        mem_ledger = engine.capture_memory_ledger().to_json()
+    except Exception as e:  # noqa: BLE001 — absence is a record
+        mem_ledger = {"schema": "ds-memory-ledger-v1", "entries": {
+            "decode_step": unavailable_entry(
+                f"{type(e).__name__}: {e}")}}
+
     return {
         "metric": "serving_scheduler_goodput_tokens_per_sec",
         "value": round(goodput, 1),
@@ -518,6 +525,16 @@ def measure_scheduler(n_requests: int = 32, rate_rps: float = 16.0,
             "kv_fraction_of_worst_case": kv_fraction,
             "wall_s": round(wall, 2),
             "platform": jax.devices()[0].platform,
+            # geometry + memory evidence: perf_report's decode waterfall
+            # and memory-ledger table read straight from this record
+            "geometry": {"hidden": cfg.hidden_size,
+                         "layers": cfg.num_hidden_layers,
+                         "heads": cfg.num_attention_heads,
+                         "kv_heads": cfg.num_key_value_heads,
+                         "intermediate": cfg.intermediate_size,
+                         "vocab": cfg.vocab_size,
+                         "dtype": "bfloat16"},
+            "memory_ledger": mem_ledger,
             **overhead,
         },
     }

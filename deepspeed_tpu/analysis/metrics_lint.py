@@ -9,7 +9,8 @@ writers happily create the file/chart and every consumer reads zeros
 from the real name.  This pass catches it at lint time.
 
 What counts as a metric literal: a plain string constant, or an
-f-string's leading literal, matching ``^(serving|fleet|resilience)/``.
+f-string's leading literal, matching
+``^(serving|fleet|resilience|observability)/``.
 Matching against the registry:
 
 * an exact literal must equal a declared name or match a declared
@@ -22,8 +23,8 @@ Matching against the registry:
   namespacing loops) is indeterminate and skipped.
 
 Declarations load by importing the metrics modules (serving / fleet /
-resilience), which declare into the default registry at import time —
-no engine, no jax.
+resilience / observability), which declare into the default registry at
+import time — no engine, no jax.
 """
 
 from __future__ import annotations
@@ -34,7 +35,7 @@ from typing import Iterable, List, Optional, Sequence
 
 from deepspeed_tpu.analysis.common import Finding, relpath
 
-NAMESPACES = ("serving/", "fleet/", "resilience/")
+NAMESPACES = ("serving/", "fleet/", "resilience/", "observability/")
 RULE = "metric-name"
 
 
@@ -42,6 +43,7 @@ def declared_specs():
     """The default registry's declarations, with every declaring metrics
     module imported first (import is what declares)."""
     import deepspeed_tpu.fleet.metrics  # noqa: F401 — declares fleet/*
+    import deepspeed_tpu.observability.metrics  # noqa: F401
     import deepspeed_tpu.resilience.metrics  # noqa: F401
     import deepspeed_tpu.serving.metrics  # noqa: F401
     from deepspeed_tpu.observability.registry import MetricsRegistry

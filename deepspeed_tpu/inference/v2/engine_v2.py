@@ -772,6 +772,56 @@ class InferenceEngineV2:
         return runner
 
     # ------------------------------------------------------------------ #
+    # Observability: compile-time memory ledger + live occupancy
+    # ------------------------------------------------------------------ #
+    def occupancy(self) -> Dict[str, float]:
+        """Live ``observability/kv_*`` + ``observability/hbm_*`` gauges
+        — host-side bookkeeping only (allocator free lists, refcounts,
+        ``seen_tokens``, static geometry arithmetic): safe to scrape
+        between steady-state decode ticks without a recompile or a host
+        sync (TraceGuard-asserted in tier-1)."""
+        from deepspeed_tpu.observability.memory import (hbm_footprint,
+                                                        kv_occupancy)
+
+        out = kv_occupancy(self.state_manager)
+        # weights only: kv_occupancy already carries the pool bytes —
+        # the same quantity must not scrape under two names
+        out.update(hbm_footprint(self.params))
+        return out
+
+    def capture_memory_ledger(self, ledger=None):
+        """HLO memory ledger of the steady-state decode program: lower +
+        compile ``decode_step`` over abstract shapes (no execution, no
+        donation of the LIVE cache) and record ``memory_analysis()`` /
+        ``cost_analysis()``.  Backends without the analysis yield an
+        explicit ``unavailable`` record."""
+        from deepspeed_tpu.observability.memory import MemoryLedger
+
+        led = ledger if ledger is not None else MemoryLedger()
+        sm = self.state_manager
+        S, B = self._batch.max_seqs, self._max_blocks
+        meta = {"max_seqs": S, "kv_blocks": sm.allocator.num_blocks,
+                "block_size": sm.block_size}
+
+        def sds(a):
+            a = np.asarray(a) if not hasattr(a, "dtype") else a
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        try:
+            compiled = self._get_decode_step().lower(
+                jax.tree_util.tree_map(sds, self.params),
+                jax.tree_util.tree_map(sds, sm.kv_cache.cache),
+                jax.ShapeDtypeStruct((S, B), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.int32)).compile()
+        except Exception as e:  # noqa: BLE001 — absence is a record
+            led.record_unavailable("decode_step",
+                                   f"{type(e).__name__}: {e}", meta=meta)
+            return led
+        led.record("decode_step", compiled, meta=meta)
+        return led
+
+    # ------------------------------------------------------------------ #
     # flush (reference engine_v2.py:210)
     # ------------------------------------------------------------------ #
     def flush(self, uids: Sequence[int]) -> None:

@@ -1,0 +1,37 @@
+"""``observability/*`` metric declarations.
+
+The observability layer emits its own telemetry: tracer ring health
+(span counts, overwritten records), compile-time HBM gauges from the
+:class:`~deepspeed_tpu.observability.memory.MemoryLedger`, and the live
+KV/tenant occupancy gauges.  Declaring the names here (same pattern as
+``serving``/``fleet``/``resilience``) puts them under the
+``metric-name`` dslint pass and the registry's unknown-name runtime
+check.
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.observability.registry import MetricsRegistry
+
+
+def _declare(reg: MetricsRegistry) -> None:
+    # tracer ring health (satellite: silent ring-wrap made visible)
+    reg.counter("observability/dropped_spans",
+                help="tracer ring records overwritten before export")
+    reg.counter("observability/spans_recorded",
+                help="total span/instant records ever written")
+    reg.gauge("observability/spans_open",
+              help="currently open (unfinished) spans")
+    # compile-time HBM ledger gauges + static residency arithmetic
+    reg.gauge("observability/hbm_*", unit="bytes",
+              help="HLO memory ledger / static HBM residency gauges")
+    # live KV-pool occupancy (host-side bookkeeping reads only)
+    reg.gauge("observability/kv_*",
+              help="KV pool occupancy: blocks live/warm/evictable, "
+                   "token + byte gauges")
+    # per-tenant token occupancy over live requests
+    reg.gauge("observability/tenant_tokens_*", unit="tokens",
+              help="live token occupancy per tenant")
+
+
+_declare(MetricsRegistry.default())

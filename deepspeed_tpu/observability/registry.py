@@ -109,7 +109,8 @@ class MetricsRegistry:
     def histogram(self, name: str, help: str = "", unit: str = ""):
         """Declared for pre-aggregated percentile families (the stack
         exports p50/p95 scalars, not raw buckets) — exposition renders
-        them as gauges."""
+        them as a quantile-labeled **summary** family, the spec-valid
+        form that keeps the kind visible on a scrape."""
         return self.declare(name, "histogram", help, unit)
 
     def lookup(self, name: str) -> Optional[MetricSpec]:
@@ -181,27 +182,59 @@ class MetricsRegistry:
             out = "_" + out
         return out
 
+    #: percentile-name convention: ``serving/p50_ttft_s`` is the 0.50
+    #: quantile of the ``serving/ttft_s`` series
+    _PCTL = re.compile(r"^(?P<head>.*/)p(?P<q>\d{2,3})_(?P<tail>.+)$")
+
     def to_prometheus(self, values: Optional[Dict[str, float]] = None
                       ) -> str:
         """Prometheus text exposition (v0.0.4) of ``values`` (default:
-        a fresh :meth:`snapshot`).  Histogram-kind declarations render as
-        gauges — the stack exports pre-aggregated percentiles."""
+        a fresh :meth:`snapshot`).
+
+        Histogram-kind declarations (the pre-aggregated percentile
+        families, named ``.../p50_x`` / ``.../p95_x`` by convention)
+        render as a **summary** family with ``quantile`` labels —
+        ``serving_ttft_s{quantile="0.50"}`` — which is the one
+        spec-valid exposition for pre-aggregated quantiles (a bare
+        sample under ``# TYPE ... histogram`` parses as an EMPTY
+        histogram plus a duplicate unknown family and strict scrapers
+        reject it; rendering as ``gauge`` — the old behavior — made
+        them indistinguishable from plain gauges).  Histogram-kind
+        names outside the percentile convention fall back to
+        ``untyped``.  Samples are grouped per family with one
+        ``# HELP``/``# TYPE`` each, so the page is self-describing and
+        scrape-parseable end to end."""
         if values is None:
             values = self.snapshot()
-        lines: List[str] = []
-        seen: set = set()
+        # (family prom-name, sort key, kind, help, sample line)
+        entries: List[Tuple[str, str, str, str, str]] = []
         for name in sorted(values):
             spec = self.lookup(name)
-            pname = self.prom_name(name)
-            kind = "untyped" if spec is None else (
-                "gauge" if spec.kind == "histogram" else spec.kind)
-            if pname not in seen:
-                seen.add(pname)
-                if spec is not None and spec.help:
-                    lines.append(f"# HELP {pname} {spec.help}")
-                lines.append(f"# TYPE {pname} {kind}")
             v = float(values[name])
-            lines.append(f"{pname} {v:g}")
+            kind = "untyped" if spec is None else spec.kind
+            help_ = spec.help if spec is not None else ""
+            if kind == "histogram":
+                m = self._PCTL.match(name)
+                if m:
+                    fam = self.prom_name(m.group("head")
+                                         + m.group("tail"))
+                    q = f"0.{m.group('q')}"
+                    entries.append(
+                        (fam, q, "summary", help_,
+                         f'{fam}{{quantile="{q}"}} {v:g}'))
+                    continue
+                kind = "untyped"      # no quantile convention to honor
+            pname = self.prom_name(name)
+            entries.append((pname, "", kind, help_, f"{pname} {v:g}"))
+        lines: List[str] = []
+        seen: set = set()
+        for fam, _q, kind, help_, sample in sorted(entries):
+            if fam not in seen:
+                seen.add(fam)
+                if help_:
+                    lines.append(f"# HELP {fam} {help_}")
+                lines.append(f"# TYPE {fam} {kind}")
+            lines.append(sample)
         return "\n".join(lines) + "\n"
 
 

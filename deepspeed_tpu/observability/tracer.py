@@ -223,6 +223,16 @@ class Tracer:
     def open_spans(self) -> List[SpanHandle]:
         return list(self._open.values())
 
+    def telemetry(self) -> Dict[str, float]:
+        """``observability/*`` ring-health scalars — the registry
+        provider form of :attr:`dropped` (a wrapped ring used to be
+        silent: records vanished and nothing counted them)."""
+        return {
+            "observability/dropped_spans": float(self.dropped),
+            "observability/spans_recorded": float(self._n),
+            "observability/spans_open": float(len(self._open)),
+        }
+
     def clear(self) -> None:
         self._ring = [None] * self.capacity
         self._n = 0
@@ -240,7 +250,9 @@ class Tracer:
                       include_open: bool = True) -> List[dict]:
         """Chrome trace-event dicts ("X" complete spans + "i" instants).
         Still-open spans export with ``args.unfinished`` (a replica died
-        mid-span; the evidence must not vanish with it)."""
+        mid-span; the evidence must not vanish with it).  A ring that
+        wrapped leads with a ``tracer/dropped_spans`` metadata event so
+        a reader knows the timeline's head was overwritten, not quiet."""
         now_ns = time.monotonic_ns()
         recs = self.records(tail)
         if include_open:
@@ -251,6 +263,16 @@ class Tracer:
                 "attrs": {**(h.attrs or {}), "unfinished": True},
             } for h in self._open.values()]
         out = []
+        if self.dropped:
+            # truncation is part of the record: phase "M" so schema
+            # validators treat it as metadata, not an anonymous span
+            out.append({
+                "name": "tracer/dropped_spans", "ph": "M",
+                "ts": self._ts_us(self._mono0_ns), "pid": os.getpid(),
+                "tid": tid if tid is not None else self.default_tid,
+                "args": {"dropped_spans": self.dropped,
+                         "capacity": self.capacity,
+                         "recorded": self._n}})
         for r in recs:
             if tid is not None and r["tid"] != tid:
                 continue

@@ -319,6 +319,7 @@ class DeepSpeedEngine:
         self._micro_compiled = None  # AOT executables (flops profiler path)
         self._apply_compiled = None
         self._apply_in_shapes = None
+        self._fused_in_shapes = None  # fused-step shapes (memory ledger)
         self._shardings: Optional[Dict[str, Any]] = None
         self._rng = jax.random.key(self.config.seed)
 
@@ -926,6 +927,15 @@ class DeepSpeedEngine:
                     "(use engine.eval() to compute a loss without "
                     "updating)")
             lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+            if self._fused_in_shapes is None:
+                # abstract input shapes let capture_memory_ledger()
+                # re-lower this exact program later without holding (or
+                # donating) live state
+                self._fused_in_shapes = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        x.shape, x.dtype,
+                        sharding=getattr(x, "sharding", None)),
+                    (self.state, lr, rng) + args)
             self.timers(FORWARD_MICRO_TIMER).start()
             self.state, loss, gnorm, overflow = self._jit_fused(
                 self.state, lr, rng, *args)
@@ -1105,6 +1115,76 @@ class DeepSpeedEngine:
                 f"{float(jax.device_get(self.state['loss_scale']))})",
                 ranks=[0])
         self._skipped_steps_logged = skipped
+
+    def capture_memory_ledger(self, ledger=None):
+        """HLO memory ledger of this engine's compiled train programs
+        (``memory_analysis`` + ``cost_analysis`` per program).
+
+        Reuses the flops-profiler AOT executables when they exist;
+        otherwise re-lowers the jitted micro/fused programs from their
+        recorded input shapes (abstract — no live state is touched or
+        donated; XLA's persistent compilation cache makes the re-compile
+        cheap on bench hosts).  Backends/paths without a compiled
+        program yield an explicit ``unavailable`` record — the BENCH
+        JSON always carries a memory claim, even a claim of absence."""
+        from deepspeed_tpu.observability.memory import MemoryLedger
+
+        led = ledger if ledger is not None else MemoryLedger()
+        meta = {
+            "zero_stage": self.zero_stage,
+            "micro_batch": self.config.train_micro_batch_size_per_gpu,
+            "dp_world_size": self.dp_world_size,
+        }
+        recorded = False
+        try:
+            if self._micro_compiled is not None:
+                led.record("train_micro", self._micro_compiled, meta=meta)
+                recorded = True
+            elif self._jit_micro is not None \
+                    and self._micro_in_shapes is not None:
+                led.record("train_micro", self._jit_micro.lower(
+                    *self._micro_in_shapes).compile(), meta=meta)
+                recorded = True
+            if self._apply_compiled is not None:
+                led.record("optimizer_apply", self._apply_compiled,
+                           meta=meta)
+                recorded = True
+            if self._jit_fused is not None \
+                    and self._fused_in_shapes is not None:
+                led.record("train_fused_step", self._jit_fused.lower(
+                    *self._fused_in_shapes).compile(), meta=meta)
+                recorded = True
+        except Exception as e:  # noqa: BLE001 — absence is a record
+            led.record_unavailable("train_step",
+                                   f"{type(e).__name__}: {e}", meta=meta)
+            return led
+        if not recorded:
+            led.record_unavailable(
+                "train_step",
+                "no compiled train program yet — run a step first",
+                meta=meta)
+        return led
+
+    def register_observability(self, registry,
+                               key: str = "train_engine"):
+        """Register host-side HBM residency gauges for the engine state
+        tree (``observability/hbm_params_bytes`` etc.) as a unified-
+        registry provider.  Pure shape arithmetic per scrape — no
+        transfers, no syncs."""
+        from deepspeed_tpu.observability.memory import tree_bytes
+
+        def provider():
+            if self.state is None:
+                return {}
+            out = {}
+            for name in ("params", "master", "opt", "acc_grads"):
+                if name in self.state:
+                    out[f"observability/hbm_{name}_bytes"] = \
+                        tree_bytes(self.state[name])
+            return out
+
+        registry.register_provider(key, provider)
+        return provider
 
     def _maybe_profile_flops(self):
         """One-shot compiler-derived flops profile at ``profile_step``

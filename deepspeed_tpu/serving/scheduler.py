@@ -111,6 +111,21 @@ class ContinuousBatchScheduler:
         self._registry_key = registry_key
         if registry is not None:
             registry.register_provider(registry_key, self.telemetry)
+            # live occupancy gauges (observability/kv_*, hbm_*,
+            # tenant_tokens_*): host-side bookkeeping reads only, so a
+            # scrape between steady-state decode ticks stays
+            # 0-recompile/0-sync (TraceGuard-asserted in tier-1)
+            if hasattr(engine, "state_manager") \
+                    and hasattr(engine.state_manager, "kv_cache"):
+                from deepspeed_tpu.observability.memory import (
+                    make_occupancy_provider)
+
+                registry.register_provider(
+                    f"{registry_key}/occupancy",
+                    make_occupancy_provider(engine, self))
+            if tracer is not None:
+                registry.register_provider(f"{registry_key}/tracer",
+                                           tracer.telemetry)
         #: speculative decoding (ROADMAP item 1): pure-decode ticks run a
         #: drafter + one multi-token verify_step instead of decode_step,
         #: emitting 1..draft_k+1 tokens per weight pass; a tick with no
@@ -251,10 +266,14 @@ class ContinuousBatchScheduler:
         return uid in self._live_uids
 
     def unregister_metrics(self) -> None:
-        """Detach this scheduler's provider from the registry (teardown
+        """Detach this scheduler's providers from the registry (teardown
         of a scheduler that is NOT being superseded under its key)."""
         if self._registry is not None:
             self._registry.unregister_provider(self._registry_key)
+            self._registry.unregister_provider(
+                f"{self._registry_key}/occupancy")
+            self._registry.unregister_provider(
+                f"{self._registry_key}/tracer")
 
     def attach_tracer(self, tracer: Optional[Tracer],
                       tid: Optional[str] = None) -> None:
@@ -262,6 +281,17 @@ class ContinuousBatchScheduler:
         (default: the tracer's own tid).  The tracer/trace_tid pair must
         move together — this is the one place that knows that."""
         self.tracer = tracer
+        if self._registry is not None:
+            # a respawn's fresh tracer supersedes the dead one's ring
+            # gauges under the same stable provider key; detaching
+            # (tracer=None) drops the provider too — a dead ring must
+            # not keep reporting (or stay pinned in memory) forever
+            if tracer is not None:
+                self._registry.register_provider(
+                    f"{self._registry_key}/tracer", tracer.telemetry)
+            else:
+                self._registry.unregister_provider(
+                    f"{self._registry_key}/tracer")
         if tracer is not None:
             self.trace_tid = tid if tid is not None else tracer.default_tid
 

@@ -200,9 +200,54 @@ def test_registry_prometheus_exposition():
     assert "# HELP serving_finished done requests" in text
     assert "# TYPE serving_finished counter" in text
     assert "serving_finished 3" in text
-    # histogram-kind families render as gauges (pre-aggregated p50/p95)
-    assert "# TYPE serving_p50_ttft_s gauge" in text
+    # histogram-kind percentile families render as quantile-labeled
+    # SUMMARY families — the spec-valid pre-aggregated form (they were
+    # indistinguishable from gauges before; a bare sample under TYPE
+    # histogram would be rejected by strict scrapers)
+    assert "# TYPE serving_ttft_s summary" in text
+    assert 'serving_ttft_s{quantile="0.50"} 0.25' in text
     assert text.endswith("\n")
+
+
+def test_prometheus_page_is_scrape_parseable(params):
+    """A live scheduler's full exposition must parse as the text format
+    v0.0.4: only HELP/TYPE comments and ``name value`` samples, every
+    TYPE one of the prometheus kinds, at most one HELP/TYPE per family,
+    every sample preceded by its family's TYPE line."""
+    reg = MetricsRegistry()
+    sched = _sched(params, registry=reg)
+    for p in _prompts():
+        sched.submit(p, sampling=SamplingParams(greedy=True,
+                                                max_new_tokens=GEN))
+    sched.run_until_idle()
+    text = reg.to_prometheus()
+    valid_kinds = {"counter", "gauge", "histogram", "summary", "untyped"}
+    typed: set = set()
+    helped: set = set()
+    kinds_seen: set = set()
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in helped, f"duplicate HELP for {name}"
+            helped.add(name)
+        elif line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            assert kind in valid_kinds, line
+            assert name not in typed, f"duplicate TYPE for {name}"
+            typed.add(name)
+            kinds_seen.add(kind)
+        else:
+            name, value = line.rsplit(None, 1)
+            float(value)                      # a parseable sample
+            family = name.split("{", 1)[0]    # quantile-labeled summary
+            assert family in typed, f"sample {name} precedes its TYPE"
+    # pre-aggregated percentiles expose as quantile-labeled summaries
+    assert "summary" in kinds_seen, kinds_seen
+    assert "serving_ttft_s" in typed
+    assert 'serving_ttft_s{quantile="0.50"}' in text
+    # live occupancy gauges ride the same page, fully declared
+    assert "observability_kv_blocks_total" in typed
+    assert not reg.unknown_names, reg.unknown_names
 
 
 def test_registry_export_wallclock_events():

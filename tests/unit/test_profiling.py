@@ -99,6 +99,49 @@ class TestFlopsProfiler:
             as_string=False)
         assert flops > 0 and macs == pytest.approx(flops / 2)
 
+    def test_get_model_profile_gpt2_block_known_geometry(self):
+        """The attribution tree the roofline consumes, pinned against a
+        hand-derived GPT-2 block formula: per-module jaxpr attribution
+        must equal the analytic matmul FLOPs EXACTLY (both count
+        2*M*N*K), and ``cost_analysis`` may only exceed it by the
+        non-matmul tail (softmax/LN/gelu — a few percent)."""
+        from deepspeed_tpu.models.gpt2 import GPT2Block, GPT2Config
+        from deepspeed_tpu.profiling.flops_profiler.profiler import (
+            module_tree, per_module_flops)
+
+        B, S = 2, 64
+        cfg = GPT2Config.tiny(hidden_size=128, num_attention_heads=4,
+                              max_position_embeddings=128,
+                              dtype=jnp.float32)
+        H, I = cfg.hidden_size, cfg.mlp_dim
+        blk = GPT2Block(cfg)
+        x = jnp.ones((B, S, H), jnp.float32)
+        params = blk.init(jax.random.key(0), x)["params"]
+
+        def fn(p, x):
+            return blk.apply({"params": p}, x)
+
+        # hand formula: qkv (3H^2) + scores/values (2 * S*H per query
+        # token) + out proj (H^2) + 2-layer MLP (2 * H*I), all 2*M*N*K
+        analytic = (2 * B * S * 3 * H * H        # c_attn
+                    + 2 * 2 * B * S * S * H      # q·k^T + att·v
+                    + 2 * B * S * H * H          # attn_out
+                    + 2 * 2 * B * S * H * I)     # c_fc + c_proj
+        per_mod = per_module_flops(fn, params, x)
+        assert sum(per_mod.values()) == pytest.approx(analytic, rel=1e-9)
+        # the tree names the issuing modules (what the waterfall reads)
+        rolled = module_tree(per_mod, depth=2)
+        for mod, want in (("GPT2Block/c_attn", 2 * B * S * 3 * H * H),
+                          ("GPT2Block/attn_out", 2 * B * S * H * H),
+                          ("GPT2Block/c_fc", 2 * B * S * H * I),
+                          ("GPT2Block/c_proj", 2 * B * S * H * I)):
+            assert rolled[mod] == pytest.approx(want, rel=1e-9), mod
+        # compiler-exact total: matmuls dominate, tail is single-digit %
+        flops, macs, _params = get_model_profile(
+            fn, args=(params, x), print_profile=False, as_string=False)
+        assert analytic <= flops <= 1.15 * analytic, (flops, analytic)
+        assert macs == pytest.approx(flops / 2)
+
     def test_engine_profile_at_step(self, tmp_path):
         config = {
             "train_micro_batch_size_per_gpu": 8,

@@ -12,11 +12,18 @@ Prometheus exposition, and validates the trace-event schema:
   trace (no orphaned children, no cross-trace parents);
 * durations are non-negative.
 
+Worker flight rings (``flight.<attempt>.json``, the crash-durable span
+tails the front-end folds into postmortems) get their own validator —
+:func:`validate_flight` checks the schema envelope, span fields,
+monotonic ring order, and the attempt-suffix ↔ incarnation-tag match,
+so a torn or mis-tagged flight file fails loudly in tier-1.
+
 Wired into tier-1 via ``tests/unit/test_observability.py`` against a
 tiny scheduler run.  Standalone::
 
     JAX_PLATFORMS=cpu python tools/obs_dump.py --out /tmp/obs
     python tools/obs_dump.py --validate trace.json
+    python tools/obs_dump.py --validate-flight run/replica0/flight.1.json
 """
 
 from __future__ import annotations
@@ -27,7 +34,7 @@ import os
 import sys
 import tempfile
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -105,6 +112,100 @@ def validate_trace(events: List[dict]) -> List[str]:
         if parent is not None and parent not in spans:
             problems.append(f"instant {i} ({e.get('name')!r}): parent "
                             f"{parent} does not exist")
+    return problems
+
+
+def validate_flight(path: str, attempt: Optional[int] = None
+                    ) -> List[str]:
+    """Validate a worker's crash-durable ``flight.<attempt>.json`` ring
+    (the FlightRecorder's atomic flush).  A torn/mis-tagged flight file
+    must fail LOUDLY here — the front-end's postmortems are built from
+    these after a SIGKILL, so quiet corruption poisons the evidence.
+
+    Checks: the ``ds-flight-v1`` schema envelope; span-record fields
+    (name/ph/ts, ``args.span_id`` unique, non-negative durations);
+    monotonic ring order (closed spans land in finish order — their end
+    timestamps must be non-decreasing); and the filename's ``.<attempt>.``
+    suffix matching every ``<replica>#<incarnation>`` span tid (a respawn
+    writing into its predecessor's ring would interleave incarnations).
+    Parent links are NOT required to resolve — the ring is a tail, and a
+    parent may have been legitimately evicted."""
+    problems: List[str] = []
+    if attempt is None:
+        base = os.path.basename(path)
+        parts = base.split(".")
+        if len(parts) >= 3 and parts[-1] == "json" \
+                and parts[-2].isdigit():
+            attempt = int(parts[-2])
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    except ValueError as e:
+        return [f"{path}: torn/unparseable JSON: {e}"]
+    if not isinstance(data, dict) \
+            or data.get("schema") != "ds-flight-v1":
+        return [f"{path}: not a ds-flight-v1 flight ring "
+                f"(schema={data.get('schema') if isinstance(data, dict) else type(data).__name__!r})"]
+    for field in ("wall_time", "ticks", "spans"):
+        if field not in data:
+            problems.append(f"missing field {field!r}")
+    spans = data.get("spans", [])
+    if not isinstance(spans, list):
+        return problems + [f"spans is {type(spans).__name__}, not a list"]
+    def num(v):
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return None
+
+    seen_ids: set = set()
+    last_end = None
+    for i, e in enumerate(spans):
+        if not isinstance(e, dict):
+            # a torn/doctored ring must report, never raise — this IS
+            # the "fails loudly" contract
+            problems.append(
+                f"span {i}: not an object ({type(e).__name__})")
+            continue
+        where = f"span {i} ({e.get('name')!r})"
+        if e.get("ph") == "M":
+            continue
+        for field in ("name", "ph", "ts", "tid"):
+            if field not in e:
+                problems.append(f"{where}: missing {field!r}")
+        args = e.get("args") if isinstance(e.get("args"), dict) else {}
+        sid = args.get("span_id")
+        if not sid:
+            problems.append(f"{where}: no args.span_id")
+        elif sid in seen_ids and e.get("ph") in ("X", "B", "i"):
+            problems.append(f"{where}: duplicate span_id {sid}")
+        else:
+            seen_ids.add(sid)
+        if e.get("ph") == "X":
+            dur = num(e.get("dur", -1.0))
+            ts = num(e.get("ts", 0.0))
+            if dur is None or dur < 0:
+                problems.append(f"{where}: X event without dur >= 0")
+            if ts is None:
+                problems.append(f"{where}: non-numeric ts "
+                                f"{e.get('ts')!r}")
+            elif dur is not None and not args.get("unfinished"):
+                end = ts + max(dur, 0.0)
+                if last_end is not None and end < last_end - 1e-3:
+                    problems.append(
+                        f"{where}: ring order broken — finish ts "
+                        f"{end:.3f} precedes previous {last_end:.3f} "
+                        "(timestamps must be monotonic in ring order)")
+                last_end = max(last_end or end, end)
+        tid = str(e.get("tid", ""))
+        if attempt is not None and "#" in tid:
+            inc = tid.rsplit("#", 1)[1]
+            if inc.isdigit() and int(inc) != attempt:
+                problems.append(
+                    f"{where}: incarnation tag {tid!r} does not match "
+                    f"flight attempt suffix .{attempt}.")
     return problems
 
 
@@ -204,7 +305,18 @@ def main(argv=None) -> int:
     ap.add_argument("--validate", default=None,
                     help="validate an existing trace JSON instead of "
                          "running the sample workload")
+    ap.add_argument("--validate-flight", default=None,
+                    help="validate a worker flight.<attempt>.json ring")
     args = ap.parse_args(argv)
+
+    if args.validate_flight is not None:
+        problems = validate_flight(args.validate_flight)
+        print(json.dumps({
+            "obs_dump": "ok" if not problems else "invalid",
+            "flight": args.validate_flight,
+            "schema_problems": len(problems),
+            "problems": problems[:20]}))
+        return 0 if not problems else 1
 
     if args.validate is not None:
         from deepspeed_tpu.observability import load_chrome_trace

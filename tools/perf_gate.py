@@ -1,0 +1,436 @@
+"""perf_gate — noise-aware performance regression gate.
+
+Two halves, composable:
+
+* **measure**: an interleaved paired-arms measurement of the 125M CPU
+  decode tick (the PERFLOG round-15 methodology: A/B/A/B arms over one
+  warm engine so host noise hits every arm alike, median tick per arm,
+  **median-of-medians** as the value, and the paired-arm spread as the
+  run's own noise floor).  A ``--seed-regression PCT`` flag injects a
+  deterministic per-tick delay — the self-test that the gate actually
+  trips.
+* **gate**: compare a fresh record against a baseline record (or a
+  BENCH_*/BASELINE history set) per metric, with direction awareness
+  (``lower``-is-better ms vs ``higher``-is-better tok/s).  A regression
+  must exceed ``max(tolerance, measured noise floor)`` — a noisy host
+  widens its own gate instead of flapping.  Exit 0 = pass, 1 = named
+  regression, 2 = usage/measure error.
+
+Tier-1 runs :func:`run_smoke` (baseline → unchanged re-run passes →
+seeded ≥10% regression fails, naming the metric)::
+
+    python tools/perf_gate.py --measure-baseline /tmp/base.json
+    python tools/perf_gate.py --baseline /tmp/base.json           # re-run
+    python tools/perf_gate.py --baseline /tmp/base.json --seed-regression 25
+    python tools/perf_gate.py --fresh new.json --history BENCH_r0*.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+GATE_METRIC = "perf_gate_decode_tick_ms"
+
+#: metric -> [(dot-path, direction)] for gating known bench records
+#: against BENCH_*/BASELINE history
+KNOWN_RECORD_SPECS: Dict[str, List[Tuple[str, str]]] = {
+    GATE_METRIC: [("value", "lower")],
+    "train_tokens_per_sec_per_chip_gpt125m": [
+        ("value", "higher"), ("extra.mfu", "higher"),
+        ("extra.step_time_ms", "lower")],
+    "fastgen_decode_tokens_per_sec_125m": [
+        ("value", "higher"), ("extra.decode_step_ms", "lower")],
+    "serving_scheduler_goodput_tokens_per_sec": [("value", "higher")],
+    "fastgen_7b_int8_decode_tokens_per_sec": [("value", "higher")],
+}
+
+
+def get_path(record: dict, path: str):
+    cur = record
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    try:
+        return float(cur)
+    except (TypeError, ValueError):
+        return None
+
+
+# --------------------------------------------------------------------- #
+# The gate
+# --------------------------------------------------------------------- #
+def compare_records(fresh: dict, history: Sequence[dict],
+                    specs: Optional[List[Tuple[str, str]]] = None,
+                    tolerance: float = 0.10) -> List[dict]:
+    """Per-metric verdicts for ``fresh`` vs the median of ``history``.
+
+    The margin a regression must exceed is
+    ``max(tolerance, noise_fresh + noise_history)`` where each record's
+    ``extra.noise_pct`` (the paired-arm spread the measurement itself
+    reported) contributes its fraction — the gate never asserts more
+    precision than the measurements had."""
+    import numpy as np
+
+    if specs is None:
+        specs = KNOWN_RECORD_SPECS.get(fresh.get("metric", ""))
+        if specs is None:
+            raise ValueError(
+                f"perf_gate: no default specs for metric "
+                f"{fresh.get('metric')!r}; pass --metric PATH:DIRECTION")
+    noise = float(fresh.get("extra", {}).get("noise_pct", 0.0)) / 100.0
+    for h in history:
+        noise += float(h.get("extra", {}).get("noise_pct", 0.0)) \
+            / 100.0 / max(len(history), 1)
+    verdicts = []
+    for path, direction in specs:
+        new = get_path(fresh, path)
+        base_vals = [v for v in (get_path(h, path) for h in history)
+                     if v is not None and v > 0]
+        if new is None or not base_vals:
+            verdicts.append({"metric": path, "status": "skipped",
+                             "reason": "missing in fresh or history"})
+            continue
+        if new <= 0:
+            # a 0 ms/tick or 0 tok/s record is a BROKEN measurement,
+            # not an infinite speedup — the gate must not bless it
+            verdicts.append({"metric": path, "status": "invalid",
+                             "fresh": new,
+                             "reason": "non-positive fresh value"})
+            continue
+        base = float(np.median(base_vals))
+        margin = max(tolerance, noise)
+        if direction == "lower":
+            ratio = new / base
+            regressed = ratio > 1.0 + margin
+        else:
+            ratio = base / new if new > 0 else float("inf")
+            regressed = ratio > 1.0 + margin
+        verdicts.append({
+            "metric": path, "direction": direction,
+            "fresh": new, "baseline": base,
+            "ratio_vs_baseline": round(
+                (new / base) if base else 0.0, 4),
+            "margin_pct": round(100.0 * margin, 2),
+            "status": "regressed" if regressed else "ok",
+        })
+    return verdicts
+
+
+def gate(fresh: dict, history: Sequence[dict],
+         specs: Optional[List[Tuple[str, str]]] = None,
+         tolerance: float = 0.10) -> Tuple[bool, List[dict]]:
+    """(ok, verdicts).  ``ok`` requires zero regressed/invalid verdicts
+    AND at least one actual comparison — an all-skipped verdict list
+    (schema drift, a wrong-shaped record) means NOTHING was gated, and
+    a gate that compared nothing must not pass."""
+    verdicts = compare_records(fresh, history, specs=specs,
+                               tolerance=tolerance)
+    bad = [v for v in verdicts if v["status"] in ("regressed", "invalid")]
+    compared = [v for v in verdicts if v["status"] == "ok"] or bad
+    if not compared:
+        verdicts.append({"metric": "(gate)", "status": "invalid",
+                         "reason": "no metric could be compared — "
+                                   "record/history shape mismatch"})
+        return False, verdicts
+    return (not bad), verdicts
+
+
+# --------------------------------------------------------------------- #
+# The measurement (125M CPU geometry decode tick, paired arms)
+# --------------------------------------------------------------------- #
+def _build_engine(clients: int, prompt_len: int, gen_tokens: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    # the honest 125M-class GQA serving geometry (12 layers, h=768) —
+    # the gate measures the REAL decode program, scaled down only in
+    # prompt/generation LENGTH so tier-1 stays inside its budget
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                      intermediate_size=2048, num_hidden_layers=12,
+                      num_attention_heads=6, num_key_value_heads=2,
+                      max_position_embeddings=2048, dtype=jnp.bfloat16)
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.key(0), np.zeros((1, 8), np.int32))["params"]
+    params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+    max_ctx = prompt_len + gen_tokens + 8
+    eng_cfg = RaggedInferenceEngineConfig.from_dict({
+        "state_manager": {"max_ragged_batch_size": 256,
+                          "max_ragged_sequence_count": clients,
+                          "max_context": max_ctx},
+        "kv_cache": {"block_size": 32},
+    })
+    return InferenceEngineV2(RaggedLlama(cfg, 32), params, eng_cfg), cfg
+
+
+def _run_arm(engine, cfg, clients: int, prompt_len: int,
+             gen_tokens: int, seed: int,
+             regression_s: float = 0.0) -> List[float]:
+    """One arm: drive ``clients`` greedy requests to completion, timing
+    every scheduler tick.  ``regression_s`` is the seeded defect — a
+    deterministic stall added to each tick, exactly what a slow kernel
+    or an accidental host sync would cost."""
+    import numpy as np
+
+    from deepspeed_tpu.serving import ContinuousBatchScheduler, SamplingParams
+
+    rng = np.random.default_rng(seed)
+    sched = ContinuousBatchScheduler(engine)
+    samp = SamplingParams(greedy=True, max_new_tokens=gen_tokens)
+    for _ in range(clients):
+        sched.submit(rng.integers(0, cfg.vocab_size,
+                                  size=(prompt_len,)).tolist(),
+                     sampling=samp)
+    ticks: List[float] = []
+    while sched.num_pending:
+        t0 = time.perf_counter()
+        sched.step()
+        if regression_s > 0.0:
+            time.sleep(regression_s)
+        ticks.append(time.perf_counter() - t0)
+    return ticks
+
+
+def _make_record(arm_medians: List[float], pairs: int, clients: int,
+                 prompt_len: int, gen_tokens: int,
+                 regression_pct: float) -> dict:
+    """arm medians -> gateable record: the value is the median of
+    per-arm median ticks and ``extra.noise_pct`` is the median relative
+    |A-B| spread of consecutive arm pairs — the gate's floor."""
+    import numpy as np
+
+    import jax
+
+    value_s = float(np.median(arm_medians))
+    spreads = [abs(arm_medians[2 * i] - arm_medians[2 * i + 1])
+               / max(value_s, 1e-12) for i in range(pairs)]
+    noise_pct = 100.0 * float(np.median(spreads))
+    return {
+        "metric": GATE_METRIC,
+        "value": round(value_s * 1e3, 4),
+        "unit": "ms/tick",
+        "extra": {
+            "arm_median_ms": [round(m * 1e3, 4) for m in arm_medians],
+            "noise_pct": round(noise_pct, 3),
+            "pairs": pairs,
+            "clients": clients,
+            "prompt_len": prompt_len,
+            "gen_tokens": gen_tokens,
+            "geometry": "125M-class llama GQA 768h/12L bf16",
+            "seeded_regression_pct": regression_pct,
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
+def measure(pairs: int = 2, clients: int = 4, prompt_len: int = 64,
+            gen_tokens: int = 12, seed: int = 0,
+            regression_pct: float = 0.0, engine=None, cfg=None,
+            warm: bool = True) -> dict:
+    """Paired-arm decode-tick measurement -> a gateable record.
+
+    ``2 * pairs`` identical arms run back to back (interleaving in time:
+    A1 B1 A2 B2 ...); see :func:`_make_record` for the value/noise
+    derivation."""
+    import numpy as np
+
+    if engine is None or cfg is None:
+        engine, cfg = _build_engine(clients, prompt_len, gen_tokens)
+    if warm:
+        _run_arm(engine, cfg, clients, prompt_len, gen_tokens, seed)
+    # calibrate the seeded stall against THIS host's healthy tick
+    regression_s = 0.0
+    if regression_pct > 0.0:
+        probe = _run_arm(engine, cfg, clients, prompt_len, gen_tokens,
+                         seed)
+        regression_s = float(np.median(probe)) * regression_pct / 100.0
+    arm_medians: List[float] = []
+    for arm in range(2 * pairs):
+        ticks = _run_arm(engine, cfg, clients, prompt_len, gen_tokens,
+                         seed + arm, regression_s=regression_s)
+        arm_medians.append(float(np.median(ticks)))
+    return _make_record(arm_medians, pairs, clients, prompt_len,
+                        gen_tokens, regression_pct)
+
+
+def measure_ab(pairs: int = 2, clients: int = 4, prompt_len: int = 64,
+               gen_tokens: int = 12, seed_a: int = 0, seed_b: int = 100,
+               regression_pct_b: float = 0.0, engine=None, cfg=None,
+               warm: bool = True) -> Tuple[dict, dict]:
+    """Two records whose arms INTERLEAVE in time (A B A B ...) — the
+    round-15 methodology applied ACROSS the gate's two sides, so a host
+    load shift lands on both alike.  Two sequential :func:`measure`
+    calls each self-report a clean intra-window noise floor yet drift
+    apart when the host's load changes BETWEEN the windows — the exact
+    gap that made an unchanged re-run read +15% under CI contention.
+    Only the smoke can do this (both sides measured now); history mode
+    gates against the past and keeps the noise-floor margin instead."""
+    import numpy as np
+
+    if engine is None or cfg is None:
+        engine, cfg = _build_engine(clients, prompt_len, gen_tokens)
+    if warm:
+        _run_arm(engine, cfg, clients, prompt_len, gen_tokens, seed_a)
+    regression_s = 0.0
+    if regression_pct_b > 0.0:
+        probe = _run_arm(engine, cfg, clients, prompt_len, gen_tokens,
+                         seed_a)
+        regression_s = float(np.median(probe)) * regression_pct_b / 100.0
+    a_medians: List[float] = []
+    b_medians: List[float] = []
+    for arm in range(2 * pairs):
+        a = _run_arm(engine, cfg, clients, prompt_len, gen_tokens,
+                     seed_a + arm)
+        b = _run_arm(engine, cfg, clients, prompt_len, gen_tokens,
+                     seed_b + arm, regression_s=regression_s)
+        a_medians.append(float(np.median(a)))
+        b_medians.append(float(np.median(b)))
+    return (_make_record(a_medians, pairs, clients, prompt_len,
+                         gen_tokens, 0.0),
+            _make_record(b_medians, pairs, clients, prompt_len,
+                         gen_tokens, regression_pct_b))
+
+
+# --------------------------------------------------------------------- #
+# The tier-1 smoke: pass on unchanged, fail on seeded regression
+# --------------------------------------------------------------------- #
+def run_smoke(tolerance: float = 0.10,
+              seeded_pct: float = 25.0) -> dict:
+    """Baseline measure -> unchanged re-measure must PASS the gate ->
+    a seeded ``seeded_pct`` per-tick regression must FAIL it, naming
+    the metric.  One engine (one compile) serves all phases, and each
+    gated comparison's two sides interleave arms in one time window
+    (:func:`measure_ab`) so background host load cannot shift one side
+    wholesale against the other."""
+    t0 = time.monotonic()
+    engine, cfg = _build_engine(clients=4, prompt_len=64, gen_tokens=12)
+    base, fresh = measure_ab(engine=engine, cfg=cfg, seed_b=100)
+    ok_same, v_same = gate(fresh, [base], tolerance=tolerance)
+    base2, seeded = measure_ab(engine=engine, cfg=cfg, warm=False,
+                               seed_b=200, regression_pct_b=seeded_pct)
+    ok_seeded, v_seeded = gate(seeded, [base2], tolerance=tolerance)
+    named = [v["metric"] for v in v_seeded if v["status"] == "regressed"]
+    assert ok_same, f"gate tripped on an unchanged re-run: {v_same}"
+    assert not ok_seeded, \
+        f"gate missed a seeded {seeded_pct}% regression: {v_seeded}"
+    assert named == ["value"], named
+    return {
+        "perf_gate_smoke": "ok",
+        "baseline_ms": base["value"],
+        "rerun_ms": fresh["value"],
+        "rerun_ratio": round(fresh["value"] / base["value"], 4),
+        "noise_pct": base["extra"]["noise_pct"],
+        "seeded_ms": seeded["value"],
+        "seeded_ratio": round(seeded["value"] / base2["value"], 4),
+        "regressed_metric": named[0],
+        "wall_s": round(time.monotonic() - t0, 2),
+    }
+
+
+def _parse_metric_args(metric_args: List[str]) -> List[Tuple[str, str]]:
+    out = []
+    for m in metric_args:
+        path, _, direction = m.partition(":")
+        if direction not in ("higher", "lower"):
+            raise SystemExit(
+                f"perf_gate: --metric wants PATH:higher|lower, got {m!r}")
+        out.append((path, direction))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perf_gate", description="noise-aware perf regression gate")
+    ap.add_argument("--measure-baseline", default=None, metavar="OUT",
+                    help="measure the 125M CPU decode tick and write the "
+                         "baseline record")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline record to gate a fresh measurement "
+                         "against")
+    ap.add_argument("--fresh", default=None,
+                    help="gate this record instead of measuring live")
+    ap.add_argument("--history", nargs="*", default=None,
+                    help="BENCH_*/BASELINE record files (history mode)")
+    ap.add_argument("--metric", action="append", default=[],
+                    metavar="PATH:DIRECTION",
+                    help="override gated metrics (e.g. value:higher)")
+    ap.add_argument("--tolerance", type=float, default=0.10)
+    ap.add_argument("--seed-regression", type=float, default=0.0,
+                    metavar="PCT", help="inject a deterministic per-tick "
+                                        "stall (gate self-test)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the tier-1 self-test sequence")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        print(json.dumps(run_smoke(tolerance=args.tolerance)))
+        return 0
+    if args.measure_baseline:
+        rec = measure()
+        with open(args.measure_baseline, "w") as f:
+            json.dump(rec, f, indent=2)
+        print(json.dumps(rec))
+        return 0
+    specs = _parse_metric_args(args.metric) or None
+    if args.history is not None:
+        if args.fresh is None:
+            raise SystemExit("perf_gate: --history needs --fresh")
+        from perf_report import load_bench_record
+
+        fresh = load_bench_record(args.fresh)
+        history, skipped = [], []
+        for p in args.history:
+            # the oldest rounds predate the JSON contract (r01 captured
+            # no record) — skip them loudly rather than refuse the gate
+            try:
+                history.append(load_bench_record(p))
+            except (OSError, ValueError) as e:
+                skipped.append(f"{p}: {e}")
+        if not history:
+            raise SystemExit(f"perf_gate: no usable history: {skipped}")
+        for s in skipped:
+            print(f"# perf_gate: skipping history {s}", file=sys.stderr)
+        ok, verdicts = gate(fresh, history, specs=specs,
+                            tolerance=args.tolerance)
+        print(json.dumps({"gate": "pass" if ok else "REGRESSION",
+                          "verdicts": verdicts}))
+        return 0 if ok else 1
+    if args.baseline is None:
+        ap.print_help()
+        return 2
+    # same loader as history mode: bare records, driver wrappers, and
+    # bench logs all unwrap to the record — the asymmetry where a
+    # BENCH_rXX wrapper silently gated nothing is exactly the vacuous
+    # pass gate() now also rejects
+    from perf_report import load_bench_record
+
+    base = load_bench_record(args.baseline)
+    if args.fresh is not None:
+        fresh = load_bench_record(args.fresh)
+    else:
+        fresh = measure(regression_pct=args.seed_regression)
+    ok, verdicts = gate(fresh, [base], specs=specs,
+                        tolerance=args.tolerance)
+    print(json.dumps({"gate": "pass" if ok else "REGRESSION",
+                      "fresh": fresh["value"], "unit": fresh.get("unit"),
+                      "verdicts": verdicts}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
