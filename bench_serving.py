@@ -533,7 +533,8 @@ def measure_scheduler(n_requests: int = 32, rate_rps: float = 16.0,
                          "kv_heads": cfg.num_key_value_heads,
                          "intermediate": cfg.intermediate_size,
                          "vocab": cfg.vocab_size,
-                         "dtype": "bfloat16"},
+                         "dtype": "bfloat16",
+                         "kv_dtype": "bfloat16"},
             "memory_ledger": mem_ledger,
             **overhead,
         },
@@ -876,6 +877,288 @@ def measure_shared_prefix(n_requests: int = 64, tenants: int = 4,
     }
 
 
+def measure_session_mix(idle_fraction: float = 0.5,
+                        resume_cadence: int = 3,
+                        max_sessions: int = 36,
+                        prompt_len: int = 88, turn_tail: int = 16,
+                        turn_gen: int = 8, block_size: int = 16,
+                        budget_blocks_bf16: int = 56,
+                        chatty_window: int = 2, max_turns: int = 4,
+                        shared_prefix: bool = False,
+                        shared_prefix_ratio: float = 0.5,
+                        tenants: int = 2,
+                        fleet: int | None = None, seed: int = 0):
+    """Chatty-vs-idle session-mix capacity benchmark — the evidence
+    harness for the KV-quantization + host-tier capacity claim.
+
+    Sessions are admitted one at a time; a ``1 - idle_fraction``
+    fraction are *chatty* (they take another turn every round while
+    recently admitted) and the rest are *idle* (probed — resumed with
+    their full history — every ``resume_cadence`` rounds, oldest-idle
+    first, the LRU worst case).  A session is **resident** while every
+    one of its resumes is served entirely from warm/restorable KV — no
+    recompute prefill and no scheduler preemption anywhere.
+
+    Two arms over the SAME HBM byte budget (``budget_blocks_bf16``
+    bf16-blocks' worth):
+
+    * baseline — bf16 KV, no host tier: LRU eviction *destroys* cold
+      blocks, so a resume past HBM capacity silently recomputes;
+    * treatment — int8 KV (per-row/per-head scales, ~1.9x blocks for
+      the same bytes) + host cold tier: cold blocks spool to host RAM
+      and restore bit-exact on resume.
+
+    ``max_resident_sessions`` per arm = sessions admitted when the first
+    recompute/preemption happened (the treatment arm typically runs to
+    the ``max_sessions`` cap — capacity is then host-RAM-bounded, and
+    the reported ratio is a floor).  Composes with ``--shared-prefix``
+    (per-tenant system prompts prepended to every session) and
+    ``--fleet N`` (both arms run N replicas behind the fleet router;
+    warm-prefix affinity routes resumes home).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.model_implementations import RaggedLlama
+    from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.serving import (ContinuousBatchScheduler,
+                                       SamplingParams)
+
+    # small geometry: this is a CAPACITY bench (blocks, bytes, spool/
+    # restore traffic), not a throughput roofline — tokens/s is
+    # reported as context, not as the headline
+    cfg = LlamaConfig(vocab_size=1024, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=512, dtype=jnp.float32)
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.key(0), np.zeros((1, 8), np.int32))["params"]
+
+    max_ctx = prompt_len + max_turns * (turn_tail + turn_gen) + 16
+    # per_token_bytes from the cache itself (one-block throwaway pools),
+    # so the equal-HBM-byte budget tracks the real storage layout
+    # instead of a hand-copied formula that drifts when the scale
+    # record layout changes
+    from deepspeed_tpu.inference.v2.ragged import BlockedKVCache
+    per_tok = {dt: BlockedKVCache(cfg.num_hidden_layers, 1, block_size,
+                                  cfg.num_key_value_heads, cfg.head_dim,
+                                  dt).per_token_bytes
+               for dt in ("bf16", "int8")}
+    budget_bytes = budget_blocks_bf16 * block_size * per_tok["bf16"]
+
+    rng = np.random.default_rng(seed)
+    shared_len = int(shared_prefix_ratio * prompt_len) if shared_prefix \
+        else 0
+    pools = {f"t{i}": rng.integers(0, cfg.vocab_size,
+                                   size=(shared_len,)).tolist()
+             for i in range(tenants)} if shared_prefix else {}
+
+    def session_prompt(sid: int):
+        tail = rng.integers(0, cfg.vocab_size,
+                            size=(prompt_len - shared_len,)).tolist()
+        if shared_prefix:
+            return pools[f"t{sid % tenants}"] + tail
+        return tail
+
+    def make_cfg(kv_dtype: str, host_tier: bool):
+        num_blocks = budget_bytes // (block_size * per_tok[kv_dtype]) + 1
+        return RaggedInferenceEngineConfig.from_dict({
+            "state_manager": {"max_ragged_batch_size": 256,
+                              "max_ragged_sequence_count": 4,
+                              "max_context": max_ctx},
+            "kv_cache": {"block_size": block_size,
+                         "num_blocks": int(num_blocks),
+                         "dtype": kv_dtype,
+                         "enable_prefix_cache": True,
+                         "host_tier": host_tier},
+        }), int(num_blocks)
+
+    sampling = SamplingParams(greedy=True, max_new_tokens=turn_gen)
+
+    def run_arm(kv_dtype: str, host_tier: bool) -> dict:
+        eng_cfg, num_blocks = make_cfg(kv_dtype, host_tier)
+
+        def factory(_name: str = "r"):
+            eng = InferenceEngineV2(RaggedLlama(cfg, block_size), params,
+                                    eng_cfg)
+            return ContinuousBatchScheduler(eng)
+
+        if fleet:
+            from deepspeed_tpu.fleet import ServingFleet
+
+            fl = ServingFleet(factory, replicas=int(fleet))
+            scheds = [rep.scheduler for _p, rep in fl.pool_members()]
+
+            def turn(sid, prompt):
+                fr = fl.submit(prompt, tenant=f"s{sid}", sampling=sampling)
+                fl.run_until_idle(max_ticks=20000)
+                assert fr.state == "finished", (fr.state, fr.finish_reason)
+                return list(prompt) + list(fr.tokens)
+
+            def preemptions():
+                return int(fl.snapshot()["fleet/preemptions"])
+        else:
+            sched = factory()
+            scheds = [sched]
+
+            def turn(sid, prompt):
+                req = sched.submit(prompt, sampling=sampling)
+                sched.run_until_idle()
+                assert req.state.value == "finished", req.finish_reason
+                return list(req.prompt) + list(req.generated)
+
+            def preemptions():
+                return int(sched.metrics.snapshot()["preemptions"])
+
+        def hit_tokens():
+            return sum(s.engine.state_manager.prefix_cache.stats.hit_tokens
+                       for s in scheds)
+
+        def tier():
+            return [s.engine.state_manager.host_tier for s in scheds
+                    if s.engine.state_manager.host_tier is not None]
+
+        # warm the compile caches with one throwaway session per replica
+        for i in range(len(scheds)):
+            turn(10_000 + i, session_prompt(10_000 + i))
+
+        histories: dict = {}
+        turns_done: dict = {}
+        last_touch: dict = {}
+        is_idle = {s: (s * 2654435761 % 100) < idle_fraction * 100
+                   for s in range(max_sessions)}
+        clean_through = 0
+        tokens_out = 0
+        recompute_tokens = 0
+        stop_reason = "cap"
+        t0 = time.perf_counter()
+
+        def resume(sid, round_no) -> bool:
+            """One follow-up turn; returns False on the first resume
+            that needed recompute (capacity exceeded)."""
+            nonlocal tokens_out, recompute_tokens
+            prev = histories[sid]
+            # full blocks of the previous history whose KV was written
+            # (the final emitted token's never was): an ideally warm
+            # resume re-attaches exactly these
+            expected = ((len(prev) - 1) // block_size) * block_size
+            tail = rng.integers(0, cfg.vocab_size,
+                                size=(turn_tail,)).tolist()
+            before = hit_tokens()
+            hist = turn(sid, prev + tail)
+            tokens_out += turn_gen
+            got = hit_tokens() - before
+            histories[sid] = hist
+            turns_done[sid] += 1
+            last_touch[sid] = round_no
+            if got < expected:
+                recompute_tokens += expected - got
+                return False
+            return True
+
+        for s in range(max_sessions):
+            histories[s] = turn(s, session_prompt(s))
+            turns_done[s] = 1
+            last_touch[s] = s
+            tokens_out += turn_gen
+            ok = True
+            # chatty activity: recently admitted chatty sessions keep
+            # talking every round
+            for c in range(max(0, s - chatty_window + 1), s + 1):
+                if ok and not is_idle[c] and turns_done[c] < max_turns:
+                    ok = resume(c, s)
+            # idle probe: every resume_cadence rounds the LRU-oldest
+            # idle session comes back — the strictest (least recently
+            # used) capacity witness
+            if ok and (s + 1) % resume_cadence == 0:
+                idle_live = [x for x in range(s + 1)
+                             if is_idle[x] and turns_done[x] < max_turns]
+                if idle_live:
+                    oldest = min(idle_live, key=lambda x: last_touch[x])
+                    ok = resume(oldest, s)
+            if not ok:
+                stop_reason = "recompute"
+                break
+            if preemptions() > 0:
+                stop_reason = "preemption"
+                break
+            clean_through = s + 1
+        wall = time.perf_counter() - t0
+
+        tiers = tier()
+        tier_stats = {}
+        if tiers:
+            agg = {}
+            for t in tiers:
+                for k, v in t.stats.as_dict().items():
+                    if k.endswith("_blocks"):
+                        agg[k] = agg.get(k, 0.0) + v
+                    else:
+                        agg[k] = max(agg.get(k, 0.0), v)
+            tier_stats = {
+                "spooled_blocks": int(agg["spooled_blocks"]),
+                "restored_blocks": int(agg["restored_blocks"]),
+                "tier_dropped_blocks": int(agg["dropped_blocks"]),
+                "tier_bytes": int(sum(t.bytes for t in tiers)),
+                "spool_p50_ms": round(1000 * agg["spool_p50_s"], 3),
+                "spool_p95_ms": round(1000 * agg["spool_p95_s"], 3),
+                "restore_p50_ms": round(1000 * agg["restore_p50_s"], 3),
+                "restore_p95_ms": round(1000 * agg["restore_p95_s"], 3),
+            }
+        return {
+            "kv_dtype": kv_dtype, "host_tier": host_tier,
+            "kv_blocks": num_blocks,
+            "max_resident_sessions": clean_through,
+            "stop_reason": stop_reason,
+            "recompute_tokens": int(recompute_tokens),
+            "preemptions": preemptions(),
+            "tokens_per_sec": round(tokens_out / max(wall, 1e-9), 1),
+            "wall_s": round(wall, 2),
+            **tier_stats,
+        }
+
+    base = run_arm("bf16", host_tier=False)
+    treat = run_arm("int8", host_tier=True)
+    ratio = treat["max_resident_sessions"] / max(
+        base["max_resident_sessions"], 1)
+    capped = treat["stop_reason"] == "cap"
+
+    return {
+        "metric": "serving_session_mix_resident_sessions",
+        "value": treat["max_resident_sessions"],
+        "unit": "resident sessions",
+        "vs_baseline": round(ratio, 4),
+        "extra": {
+            "baseline": base,
+            "treatment": treat,
+            "capacity_ratio": round(ratio, 4),
+            # treatment hitting the session cap means capacity is
+            # host-RAM-bounded — the ratio is a floor, not a ceiling
+            "treatment_capped": capped,
+            "idle_fraction": idle_fraction,
+            "resume_cadence": resume_cadence,
+            "max_sessions": max_sessions,
+            "prompt_len": prompt_len,
+            "turn_tail": turn_tail,
+            "turn_gen": turn_gen,
+            "block_size": block_size,
+            "hbm_budget_bytes": int(budget_bytes),
+            "shared_prefix": bool(shared_prefix),
+            "fleet": int(fleet) if fleet else 0,
+            "geometry": {"hidden": cfg.hidden_size,
+                         "layers": cfg.num_hidden_layers,
+                         "heads": cfg.num_attention_heads,
+                         "kv_heads": cfg.num_key_value_heads,
+                         "intermediate": cfg.intermediate_size,
+                         "vocab": cfg.vocab_size,
+                         "dtype": "float32", "kv_dtype": "int8"},
+            "platform": __import__("jax").devices()[0].platform,
+        },
+    }
+
+
 def measure_fleet(n_replicas: int = 2, disaggregate: str | None = None,
                   shared_prefix: bool = False,
                   shared_prefix_ratio: float = 0.9,
@@ -1061,11 +1344,18 @@ if __name__ == "__main__":
         a.startswith("--shared-prefix-ratio") for a in sys.argv)
     _fleet = any(a == "--fleet" or a.startswith("--fleet=")
                  for a in sys.argv)
+    _session_mix = "--session-mix" in sys.argv
     _disagg = _cli_str("--disaggregate", None)
     if _disagg is not None and not _fleet:
         raise SystemExit("bench_serving: --disaggregate P:D requires "
                          "--fleet N")
+    if _disagg is not None and _session_mix:
+        raise SystemExit("bench_serving: --session-mix composes with "
+                         "--fleet N but not --disaggregate")
     _speculative = "--speculative" in sys.argv
+    if _speculative and _session_mix:
+        raise SystemExit("bench_serving: --session-mix does not compose "
+                         "with --speculative")
     _trace_out = _cli_str("--trace", None)
     if _trace_out is not None and "--scheduler" not in sys.argv:
         raise SystemExit("bench_serving: --trace OUT requires "
@@ -1077,13 +1367,16 @@ if __name__ == "__main__":
         raise SystemExit("bench_serving: --draft-k K requires "
                          "--speculative")
     # --shared-prefix and --speculative compose with --fleet (they select
-    # the fleet's workload / decode mode) and with each other; every
+    # the fleet's workload / decode mode) and with each other;
+    # --session-mix composes with --shared-prefix and --fleet; every
     # other pairing is a conflict
     _modes = [f for f, on in [("--7b", "--7b" in sys.argv),
                               ("--scheduler", "--scheduler" in sys.argv),
-                              ("--fleet", _fleet),
+                              ("--session-mix", _session_mix),
+                              ("--fleet", _fleet and not _session_mix),
                               ("--shared-prefix",
-                               _shared_prefix and not _fleet),
+                               _shared_prefix and not _fleet
+                               and not _session_mix),
                               ("--speculative",
                                _speculative and not _fleet
                                and not _shared_prefix)] if on]
@@ -1092,6 +1385,22 @@ if __name__ == "__main__":
     try:
         if "--7b" in sys.argv:
             print(json.dumps(measure_7b()))
+        elif _session_mix:
+            try:
+                # default 2 covers bare "--fleet" as the LAST argv token
+                # (no following value -> _cli_float's default)
+                _sm_fleet = (int(_cli_float("--fleet", 2)) or 2) \
+                    if _fleet else None
+            except ValueError:
+                _sm_fleet = 2        # bare "--fleet" next to another flag
+            print(json.dumps(measure_session_mix(
+                idle_fraction=_cli_float("--idle-fraction", 0.5),
+                resume_cadence=int(_cli_float("--resume-cadence", 3)),
+                max_sessions=int(_cli_float("--max-sessions", 36)),
+                shared_prefix=_shared_prefix,
+                shared_prefix_ratio=_cli_float("--shared-prefix-ratio",
+                                               0.5),
+                fleet=_sm_fleet)))
         elif "--scheduler" in sys.argv:
             print(json.dumps(measure_scheduler(trace_out=_trace_out)))
         elif _fleet:
@@ -1121,6 +1430,8 @@ if __name__ == "__main__":
         traceback.print_exc(file=sys.stderr)
         metric = ("fastgen_7b_int8_decode_tokens_per_sec"
                   if "--7b" in sys.argv
+                  else "serving_session_mix_resident_sessions"
+                  if _session_mix
                   else "serving_scheduler_goodput_tokens_per_sec"
                   if "--scheduler" in sys.argv
                   else "serving_fleet_goodput_tokens_per_sec"

@@ -34,11 +34,38 @@ class KVCacheConfig(DeepSpeedConfigModel):
     block_size: int = 64
     num_blocks: Optional[int] = None     # None -> derived from max_context
     cache_dtype: Any = None
+    #: pool storage dtype: ``"bf16"`` (default via model dtype) or
+    #: ``"int8"`` — block-quantized KV with per-row/per-kv-head fp32
+    #: scales stored alongside the pool and dequant fused into the paged
+    #: attention kernels (halves KV bytes per token vs bf16, modulo the
+    #: scale records); also accepts ``"f32"``/``"f16"``.  Takes
+    #: precedence over the legacy ``cache_dtype``.
+    dtype: Optional[str] = None
     #: radix prefix cache over the block pool: requests sharing a token
     #: prefix (system prompts, preempt-resume recompute) attach to warm KV
     #: blocks instead of re-prefilling them (ref-counted, LRU-evicted
     #: under pressure, copy-on-write on shared-block writes)
     enable_prefix_cache: bool = False
+    #: host-memory cold tier: refcount-1 LRU leaves the prefix cache
+    #: would destroy under KV pressure spool to host RAM instead
+    #: (gather_blocks payload, scales included) and restore bit-exact on
+    #: ``attach_prefix``/session resume — capacity beyond HBM for idle
+    #: sessions.  Requires ``enable_prefix_cache``.
+    host_tier: bool = False
+    #: host-tier byte budget (None = unbounded); oldest entries drop
+    #: first past the budget
+    host_tier_bytes: Optional[int] = None
+
+    def _validate(self):
+        if self.dtype is not None:
+            from deepspeed_tpu.inference.v2.ragged.kv_cache import (
+                resolve_kv_dtype)
+
+            resolve_kv_dtype(self.dtype)      # raises on unknown spelling
+        if self.host_tier and not self.enable_prefix_cache:
+            raise ValueError(
+                "kv_cache.host_tier requires enable_prefix_cache — cold "
+                "blocks spool from the radix tree's LRU eviction path")
 
 
 @dataclasses.dataclass

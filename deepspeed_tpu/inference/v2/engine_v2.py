@@ -17,6 +17,7 @@ round via the sequence's ``pending`` queue.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -116,6 +117,27 @@ class InferenceEngineV2:
             sm_cfg, kv_cfg, num_layers=model.num_layers,
             num_kv_heads=model.num_kv_heads, head_dim=model.head_dim,
             dtype=getattr(model.config, "dtype", None))
+        if self.state_manager.kv_cache.quantized:
+            if not getattr(model, "supports_quantized_kv", False):
+                raise ValueError(
+                    f"kv_cache.dtype=int8 needs a model whose attention "
+                    f"path quantizes on insert and fuses the dequant "
+                    f"(RaggedLlama family); {type(model).__name__} would "
+                    f"silently write float KV into an int8 pool")
+            if getattr(model, "tp", 1) > 1:
+                raise ValueError(
+                    "int8 KV does not compose with tensor parallelism "
+                    "yet — the scale records need their own kv-head "
+                    "partition spec")
+            if model.head_dim % 128 != 0:
+                log_dist(
+                    f"kv_cache.dtype=int8 with head_dim="
+                    f"{model.head_dim}: the fused-dequant Pallas "
+                    f"kernels need 128-aligned head dims, so attention "
+                    f"reads take the XLA gather+dequant path — the "
+                    f"capacity win (int8 bytes in HBM) stands, the "
+                    f"decode-bandwidth win does not",
+                    level=logging.WARNING)
         self._max_blocks = -(-sm_cfg.max_context // kv_cfg.block_size)
         self._batch = RaggedBatchWrapper(
             token_budget=sm_cfg.max_ragged_batch_size,

@@ -116,8 +116,20 @@ def paged_attention_usable(q, k_pool, block_size: int) -> bool:
 # for pad slots.
 # ===================================================================== #
 def _decode_kernel(token_slot, token_pos, tables, q_ref, k_hbm, v_hbm,
-                   o_ref, k_buf, v_buf, sems, *, block_size, scale,
-                   window):
+                   *refs, block_size, scale, window, quantized=False):
+    # quantized mode threads two extra HBM scale pools + their VMEM
+    # double buffers through the SAME kernel body: dequant happens here
+    # on the block walk (int8 payload * per-row/per-head scale), fused
+    # into the online-softmax update — never as a separate materialized
+    # pass, and the HBM read is int8 bytes + the tiny scale stream.
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
+         sems) = refs
+        streams = ((k_buf, k_hbm, 0), (v_buf, v_hbm, 1),
+                   (ks_buf, ks_hbm, 2), (vs_buf, vs_hbm, 3))
+    else:
+        o_ref, k_buf, v_buf, sems = refs
+        streams = ((k_buf, k_hbm, 0), (v_buf, v_hbm, 1))
     t = pl.program_id(0)
     pos = token_pos[t]
     slot = token_slot[t]
@@ -139,8 +151,16 @@ def _decode_kernel(token_slot, token_pos, tables, q_ref, k_hbm, v_hbm,
 
     @pl.when(n > 0)
     def _():
-        dma(k_buf, k_hbm, 0, lo, 0).start()
-        dma(v_buf, v_hbm, 0, lo, 1).start()
+        for buf, hbm, which in streams:
+            dma(buf, hbm, 0, lo, which).start()
+
+    def load_kv(sl):
+        k = k_buf[sl].astype(jnp.float32)             # [bs, Hkv, D]
+        v = v_buf[sl].astype(jnp.float32)
+        if quantized:                                 # fused dequant
+            k = k * ks_buf[sl].astype(jnp.float32)[..., None]
+            v = v * vs_buf[sl].astype(jnp.float32)[..., None]
+        return k, v
 
     def body(i, carry):
         m_prev, l_prev, acc = carry
@@ -150,13 +170,12 @@ def _decode_kernel(token_slot, token_pos, tables, q_ref, k_hbm, v_hbm,
         @pl.when(i + 1 < n)
         def _():
             nsl = jax.lax.rem(i + 1, 2)
-            dma(k_buf, k_hbm, nsl, j + 1, 0).start()
-            dma(v_buf, v_hbm, nsl, j + 1, 1).start()
+            for buf, hbm, which in streams:
+                dma(buf, hbm, nsl, j + 1, which).start()
 
-        dma(k_buf, k_hbm, sl, j, 0).wait()
-        dma(v_buf, v_hbm, sl, j, 1).wait()
-        k = k_buf[sl].astype(jnp.float32)             # [bs, Hkv, D]
-        v = v_buf[sl].astype(jnp.float32)
+        for buf, hbm, which in streams:
+            dma(buf, hbm, sl, j, which).wait()
+        k, v = load_kv(sl)
         s = jax.lax.dot_general(
             qg, k.transpose(1, 2, 0), (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale   # [Hkv, g, bs]
@@ -182,8 +201,16 @@ def _decode_kernel(token_slot, token_pos, tables, q_ref, k_hbm, v_hbm,
     m0 = jnp.full((h, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((h, 1), jnp.float32)
     acc0 = jnp.zeros((h, d), jnp.float32)
-    _m, l, acc = jax.lax.fori_loop(0, n, body, (m0, l0, acc0),
-                                   unroll=False)
+    if quantized:
+        # no unroll kwarg: jax 0.4.37 rejects `unroll` with a traced
+        # trip count (the verify kernel's long-standing form); the
+        # unquantized call below keeps its historical spelling — its
+        # interpret-mode behavior on old jax is part of the frozen
+        # tier-1 seed set and must not change
+        _m, l, acc = jax.lax.fori_loop(0, n, body, (m0, l0, acc0))
+    else:
+        _m, l, acc = jax.lax.fori_loop(0, n, body, (m0, l0, acc0),
+                                       unroll=False)
     safe_l = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
 
@@ -196,13 +223,21 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                            token_slot: jnp.ndarray,
                            token_pos: jnp.ndarray,
                            *, block_size: int, window: Any = None,
-                           interpret: Any = None) -> jnp.ndarray:
+                           interpret: Any = None,
+                           k_scale: Any = None,
+                           v_scale: Any = None) -> jnp.ndarray:
     """Decode-shaped paged attention: q [S, H, D] (one token per live
     slot), KV pool resident in HBM, per-sequence dynamic walk over live
-    blocks.  Returns [S, H, D] (pad slots, pos<0, give zeros)."""
+    blocks.  Returns [S, H, D] (pad slots, pos<0, give zeros).
+
+    ``k_scale``/``v_scale`` (``[rows, Hkv]`` fp32, int8 pools) switch on
+    the fused-dequant mode: the scale pools ride in HBM next to the
+    payload, each walked block DMAs payload + scales together, and the
+    dequant happens in VMEM inside the online-softmax update."""
     s_count, h, d = q.shape
     hkv = k_pool.shape[1]
     nb = k_pool.shape[0] // block_size
+    quantized = k_scale is not None
     if interpret is None:
         try:
             interpret = jax.devices()[0].platform != "tpu"
@@ -213,30 +248,43 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     vp = v_pool.reshape(nb, block_size, hkv, d)
     scale = 1.0 / (d ** 0.5)
 
+    n_streams = 4 if quantized else 2
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda t, slot, pos, tab: (t, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((2, block_size, hkv, d), k_pool.dtype),
+        pltpu.VMEM((2, block_size, hkv, d), v_pool.dtype),
+    ]
+    operands = [q, kp, vp]
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        scratch += [pltpu.VMEM((2, block_size, hkv), jnp.float32),
+                    pltpu.VMEM((2, block_size, hkv), jnp.float32)]
+        operands += [k_scale.reshape(nb, block_size, hkv),
+                     v_scale.reshape(nb, block_size, hkv)]
+    scratch.append(pltpu.SemaphoreType.DMA((2, n_streams)))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(s_count,),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda t, slot, pos, tab: (t, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, d),
                                lambda t, slot, pos, tab: (t, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, block_size, hkv, d), k_pool.dtype),
-            pltpu.VMEM((2, block_size, hkv, d), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(_decode_kernel, block_size=block_size,
-                               scale=scale, window=window)
+                               scale=scale, window=window,
+                               quantized=quantized)
     return pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s_count, h, d), q.dtype),
         interpret=bool(interpret),
     )(token_slot.astype(jnp.int32), token_pos.astype(jnp.int32),
-      block_tables.astype(jnp.int32), q, kp, vp)
+      block_tables.astype(jnp.int32), *operands)
 
 
 # ===================================================================== #
@@ -251,8 +299,20 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
 # amortises the per-step dispatch cost that dominates 125M decode.
 # ===================================================================== #
 def _verify_kernel(token_slot, token_pos, tables, q_ref, k_hbm, v_hbm,
-                   o_ref, k_buf, v_buf, sems, *, block_size, scale,
-                   window, k_tokens):
+                   *refs, block_size, scale, window, k_tokens,
+                   quantized=False):
+    # same fused-dequant contract as _decode_kernel: quantized mode adds
+    # HBM scale pools + VMEM scale buffers, and the K query rows share
+    # ONE dequantized block per walk step (the whole point — the int8
+    # read amortises across all K candidate positions)
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
+         sems) = refs
+        streams = ((k_buf, k_hbm, 0), (v_buf, v_hbm, 1),
+                   (ks_buf, ks_hbm, 2), (vs_buf, vs_hbm, 3))
+    else:
+        o_ref, k_buf, v_buf, sems = refs
+        streams = ((k_buf, k_hbm, 0), (v_buf, v_hbm, 1))
     t = pl.program_id(0)
     pos0 = token_pos[t]                   # first fed position (0 on pads)
     slot = token_slot[t]
@@ -275,8 +335,8 @@ def _verify_kernel(token_slot, token_pos, tables, q_ref, k_hbm, v_hbm,
 
     @pl.when(n > 0)
     def _():
-        dma(k_buf, k_hbm, 0, lo, 0).start()
-        dma(v_buf, v_hbm, 0, lo, 1).start()
+        for buf, hbm, which in streams:
+            dma(buf, hbm, 0, lo, which).start()
 
     def body(i, carry):
         m_prev, l_prev, acc = carry       # [K*H,1], [K*H,1], [K*H,D]
@@ -286,13 +346,16 @@ def _verify_kernel(token_slot, token_pos, tables, q_ref, k_hbm, v_hbm,
         @pl.when(i + 1 < n)
         def _():
             nsl = jax.lax.rem(i + 1, 2)
-            dma(k_buf, k_hbm, nsl, j + 1, 0).start()
-            dma(v_buf, v_hbm, nsl, j + 1, 1).start()
+            for buf, hbm, which in streams:
+                dma(buf, hbm, nsl, j + 1, which).start()
 
-        dma(k_buf, k_hbm, sl, j, 0).wait()
-        dma(v_buf, v_hbm, sl, j, 1).wait()
+        for buf, hbm, which in streams:
+            dma(buf, hbm, sl, j, which).wait()
         k = k_buf[sl].astype(jnp.float32)             # [bs, Hkv, D]
         v = v_buf[sl].astype(jnp.float32)
+        if quantized:                                 # fused dequant
+            k = k * ks_buf[sl].astype(jnp.float32)[..., None]
+            v = v * vs_buf[sl].astype(jnp.float32)[..., None]
         ms, ls, accs = [], [], []
         for kq in range(k_tokens):        # static unroll: K is small
             q = qf[kq * h:(kq + 1) * h]               # [H, D]
@@ -343,7 +406,9 @@ def paged_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                            token_pos: jnp.ndarray,
                            *, block_size: int, k_tokens: int,
                            window: Any = None,
-                           interpret: Any = None) -> jnp.ndarray:
+                           interpret: Any = None,
+                           k_scale: Any = None,
+                           v_scale: Any = None) -> jnp.ndarray:
     """Multi-query paged attention for speculative verify batches.
 
     q: [T, H, D] with ``T = S * k_tokens`` and rows slot-major — row
@@ -357,6 +422,7 @@ def paged_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     s_count = t_count // k_tokens
     hkv = k_pool.shape[1]
     nb = k_pool.shape[0] // block_size
+    quantized = k_scale is not None
     if interpret is None:
         try:
             interpret = jax.devices()[0].platform != "tpu"
@@ -371,32 +437,44 @@ def paged_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     pos0 = token_pos.reshape(s_count, k_tokens)[:, 0].astype(jnp.int32)
     qf = q.reshape(s_count, k_tokens * h, d)
 
+    n_streams = 4 if quantized else 2
+    in_specs = [
+        pl.BlockSpec((1, k_tokens * h, d),
+                     lambda t, slot, pos, tab: (t, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((2, block_size, hkv, d), k_pool.dtype),
+        pltpu.VMEM((2, block_size, hkv, d), v_pool.dtype),
+    ]
+    operands = [qf, kp, vp]
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        scratch += [pltpu.VMEM((2, block_size, hkv), jnp.float32),
+                    pltpu.VMEM((2, block_size, hkv), jnp.float32)]
+        operands += [k_scale.reshape(nb, block_size, hkv),
+                     v_scale.reshape(nb, block_size, hkv)]
+    scratch.append(pltpu.SemaphoreType.DMA((2, n_streams)))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(s_count,),
-        in_specs=[
-            pl.BlockSpec((1, k_tokens * h, d),
-                         lambda t, slot, pos, tab: (t, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, k_tokens * h, d),
                                lambda t, slot, pos, tab: (t, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, block_size, hkv, d), k_pool.dtype),
-            pltpu.VMEM((2, block_size, hkv, d), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(_verify_kernel, block_size=block_size,
                                scale=scale, window=window,
-                               k_tokens=k_tokens)
+                               k_tokens=k_tokens, quantized=quantized)
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s_count, k_tokens * h, d),
                                        q.dtype),
         interpret=bool(interpret),
-    )(slot0, pos0, block_tables.astype(jnp.int32), qf, kp, vp)
+    )(slot0, pos0, block_tables.astype(jnp.int32), *operands)
     return out.reshape(t_count, h, d)
 
 
@@ -684,6 +762,58 @@ def _dslint_paged_verify_case():
     vpos = (pos[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(-1)
     paged_verify_attention(qv, kp, vp, tables, vslot, vpos,
                            block_size=bs, k_tokens=K, interpret=True)
+
+
+def _dslint_paged_int8_setup():
+    import numpy as np
+
+    bs, kp, vp, tables, slot, pos, _q = _dslint_paged_setup(128)
+    rows = kp.shape[0]
+    rng = np.random.default_rng(11)
+    kq = jnp.asarray(rng.integers(-127, 128, size=(rows, 2, 128)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, size=(rows, 2, 128)), jnp.int8)
+    ks = jnp.asarray(rng.random((rows, 2), np.float32) * 0.05)
+    vs = jnp.asarray(rng.random((rows, 2), np.float32) * 0.05)
+    return bs, kq, vq, ks, vs, tables, slot, pos
+
+
+@pallas_kernel_case(
+    "paged_decode_dma_int8",
+    note="int8 block-quantized decode: payload + per-row/per-head "
+         "scale pools both walk in HBM (memory_space=ANY); dequant is "
+         "fused into the double-buffered block walk — the VMEM cost is "
+         "the int8 block scratch plus two [bs, Hkv] scale buffers")
+def _dslint_paged_decode_int8_case():
+    import numpy as np
+
+    bs, kq, vq, ks, vs, tables, slot, pos = _dslint_paged_int8_setup()
+    S = tables.shape[0]
+    rng = np.random.default_rng(12)
+    q = jnp.asarray(rng.standard_normal((S, 8, 128)).astype(np.float32),
+                    jnp.bfloat16)
+    paged_decode_attention(q, kq, vq, tables, slot, pos, block_size=bs,
+                           k_scale=ks, v_scale=vs, interpret=True)
+
+
+@pallas_kernel_case(
+    "paged_verify_multiquery_int8",
+    note="int8 speculative verify: K=4 query rows share one "
+         "fused-dequant block walk (int8 payload + scale DMAs amortise "
+         "across every candidate position)")
+def _dslint_paged_verify_int8_case():
+    import numpy as np
+
+    K = 4
+    bs, kq, vq, ks, vs, tables, slot, pos = _dslint_paged_int8_setup()
+    S = tables.shape[0]
+    rng = np.random.default_rng(13)
+    qv = jnp.asarray(rng.standard_normal((S * K, 8, 128)).astype(np.float32),
+                     jnp.bfloat16)
+    vslot = jnp.repeat(jnp.arange(S, dtype=jnp.int32), K)
+    vpos = (pos[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(-1)
+    paged_verify_attention(qv, kq, vq, tables, vslot, vpos,
+                           block_size=bs, k_tokens=K,
+                           k_scale=ks, v_scale=vs, interpret=True)
 
 
 @pallas_kernel_case("paged_prefill",

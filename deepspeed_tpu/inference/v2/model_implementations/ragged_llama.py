@@ -96,7 +96,8 @@ def _rms_norm(x, scale, eps):
 
 def _paged_attention(q, k_pool, v_pool, batch, block_size,
                      use_kernel=None, window=None, prefill_tile=None,
-                     decode_mode=False, force_dense=None, verify_k=None):
+                     decode_mode=False, force_dense=None, verify_k=None,
+                     k_scale=None, v_scale=None):
     """Paged attention over the blocked KV pool.
 
     q: [T, H, D]; k_pool/v_pool: [num_blocks*bs, Hkv, D].
@@ -122,7 +123,14 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
     dense/gather fallbacks for comparison.
 
     The plain XLA gather composition below is the reference/CPU path.
+
+    ``k_scale``/``v_scale`` (int8 pools; ``[rows, Hkv]`` fp32) select
+    the block-quantized mode: the hot decode/verify Pallas kernels fuse
+    the per-row/per-head dequant into their HBM block walk; every other
+    path dequantizes at its gather/read site (XLA fuses the cast-and-
+    scale into the consuming einsum).
     """
+    quantized = k_scale is not None
     if use_kernel is None:
         try:
             use_kernel = jax.devices()[0].platform == "tpu"
@@ -147,7 +155,7 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
                     q, k_pool, v_pool, batch["block_tables"],
                     batch["token_slot"], batch["token_pos"],
                     block_size=block_size, k_tokens=int(verify_k),
-                    window=w)
+                    window=w, k_scale=k_scale, v_scale=v_scale)
             if decode_mode:
                 # the manual-DMA kernel copies [bs, Hkv, D] pool blocks,
                 # whose lane dim D must be 128-aligned, and it wins when
@@ -156,25 +164,42 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
                 # in tools/profile_decode_attn.py: 4.28 vs 5.77 ms at
                 # pool 512 blk / ctx 2k).  Tight pools (pool ~ live, the
                 # serving-dense case) keep the dense read below, which
-                # measured ~10% faster there.
+                # measured ~10% faster there.  Quantized pools ALWAYS
+                # take the DMA kernel: the dense path would dequantize
+                # the whole pool, and the capacity regime int8 exists
+                # for (many spooled/idle sessions) is precisely
+                # pool >> live.
                 S_, B_ = batch["block_tables"].shape
                 big_pool = k_pool.shape[0] > 2 * S_ * B_ * block_size
-                if q.shape[-1] % 128 == 0 and big_pool:
+                if q.shape[-1] % 128 == 0 and (big_pool or quantized):
                     return paged_decode_attention(
                         q, k_pool, v_pool, batch["block_tables"],
                         batch["token_slot"], batch["token_pos"],
-                        block_size=block_size, window=w)
-            elif prefill_tile and q.shape[0] % prefill_tile == 0:
+                        block_size=block_size, window=w,
+                        k_scale=k_scale, v_scale=v_scale)
+            elif not quantized and prefill_tile \
+                    and q.shape[0] % prefill_tile == 0:
+                # prefill kernels are not scale-aware (prefill is
+                # compute-bound — the int8 win is decode bandwidth);
+                # quantized prefill takes the XLA gather+dequant below
                 return paged_prefill_attention(
                     q, k_pool, v_pool, batch["block_tables"],
                     batch["token_slot"], batch["token_pos"],
                     block_size=block_size, tile_q=int(prefill_tile),
                     window=w)
-            else:
+            elif not quantized:
                 return paged_attention(
                     q, k_pool, v_pool, batch["block_tables"],
                     batch["token_slot"], batch["token_pos"],
                     block_size=block_size, window=w)
+    if quantized:
+        # reference/CPU path (and quantized TPU prefill / non-128 head
+        # dims): dequantize at the READ site of each branch below, never
+        # the whole pool up front — the dense branch reads every pool
+        # row by design (pool ~ live), but the gather branch serves the
+        # pool >> live capacity regime where an O(pool) f32
+        # materialization would cost 4x the memory int8 just saved
+        from deepspeed_tpu.inference.v2.ragged.kv_cache import dequantize_kv
     block_tables = batch["block_tables"]          # [S, B]
     token_slot = batch["token_slot"]              # [T]
     token_pos = batch["token_pos"]                # [T]
@@ -205,6 +230,11 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
             BlockedAllocator)
 
         trash = BlockedAllocator.TRASH_BLOCK
+        if quantized:
+            # pool-wide dequant matches this branch's pool-wide read
+            # (it only runs when rows <= 2*S*C, i.e. pool ~ live)
+            k_pool = dequantize_kv(k_pool, k_scale, jnp.float32)
+            v_pool = dequantize_kv(v_pool, v_scale, jnp.float32)
         rows = k_pool.shape[0]
         rowblk = jnp.arange(rows, dtype=jnp.int32) // block_size
         rowoff = jnp.arange(rows, dtype=jnp.int32) % block_size
@@ -237,6 +267,12 @@ def _paged_attention(q, k_pool, v_pool, batch, block_size,
                 ).reshape(S, C)
     k_ctx = k_pool[flat_idx]                      # [S, C, Hkv, D]
     v_ctx = v_pool[flat_idx]
+    if quantized:
+        # dequantize the GATHERED slice — O(S*C) work and memory, never
+        # the whole pool; gather-then-dequant is bitwise identical to
+        # dequant-then-gather (dequant is per-row elementwise)
+        k_ctx = dequantize_kv(k_ctx, k_scale[flat_idx], jnp.float32)
+        v_ctx = dequantize_kv(v_ctx, v_scale[flat_idx], jnp.float32)
 
     if decode_mode:
         # large-pool decode: T == S with token_slot == arange, so the
@@ -295,16 +331,39 @@ def ragged_attention_block(lp_attn, xa, layer_cache, batch, block_size, cfg,
     # apply_rotary broadcasts over [T, H, D] with cos/sin [T, 1, D/2]
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
-    k_pool = layer_cache["k"].at[kv_dest].set(k.astype(layer_cache["k"].dtype))
-    v_pool = layer_cache["v"].at[kv_dest].set(v.astype(layer_cache["v"].dtype))
+    # dtype-polymorphic pool (static branch: the leaf dtype is known at
+    # trace time).  int8 mode quantizes ON INSERT — payload + per-row/
+    # per-head scale scatter in the same step, so the cache is always
+    # self-describing and every downstream reader (kernels, COW copy,
+    # host spool, disaggregated handoff) sees one consistent record.
+    quantized = layer_cache["k"].dtype == jnp.int8
+    if quantized:
+        from deepspeed_tpu.inference.v2.ragged.kv_cache import quantize_kv
+
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        k_pool = layer_cache["k"].at[kv_dest].set(kq)
+        v_pool = layer_cache["v"].at[kv_dest].set(vq)
+        k_scale = layer_cache["k_scale"].at[kv_dest].set(ks)
+        v_scale = layer_cache["v_scale"].at[kv_dest].set(vs)
+        new_cache = {"k": k_pool, "v": v_pool,
+                     "k_scale": k_scale, "v_scale": v_scale}
+    else:
+        k_scale = v_scale = None
+        k_pool = layer_cache["k"].at[kv_dest].set(
+            k.astype(layer_cache["k"].dtype))
+        v_pool = layer_cache["v"].at[kv_dest].set(
+            v.astype(layer_cache["v"].dtype))
+        new_cache = {"k": k_pool, "v": v_pool}
     out = _paged_attention(q, k_pool, v_pool, batch, block_size,
                            window=cfg.sliding_window,
                            prefill_tile=prefill_tile,
-                           decode_mode=decode_mode, verify_k=verify_k)
+                           decode_mode=decode_mode, verify_k=verify_k,
+                           k_scale=k_scale, v_scale=v_scale)
     out = qmm(out.reshape(-1, h * d), lp_attn["o_proj"]["kernel"], dt)
     if ax is not None:
         out = jax.lax.psum(out, ax)                   # row-parallel attn-out
-    return out, {"k": k_pool, "v": v_pool}
+    return out, new_cache
 
 
 class RaggedLlama:
@@ -315,6 +374,10 @@ class RaggedLlama:
     placed with :func:`shard_ragged_params` / ``KV_SPEC`` — the engine does
     this).
     """
+
+    #: the shared ragged_attention_block write path quantizes on insert
+    #: and threads scales — int8 KV (kv_cache.dtype="int8") is supported
+    supports_quantized_kv = True
 
     def __init__(self, config: LlamaConfig, block_size: int,
                  mesh: Optional[Mesh] = None, tp_axis: str = "model"):

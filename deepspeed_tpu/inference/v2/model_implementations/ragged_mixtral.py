@@ -79,6 +79,10 @@ def dropless_moe(x, moe_params, k: int, dtype, grouped=None):
 class RaggedMixtral:
     """Callable ragged MoE forward bound to a :class:`MixtralConfig`."""
 
+    #: attention goes through the shared ragged_attention_block, whose
+    #: write path quantizes on insert — int8 KV works here too
+    supports_quantized_kv = True
+
     def __init__(self, config: MixtralConfig, block_size: int):
         self.config = config
         self.block_size = block_size

@@ -1,7 +1,11 @@
 """Ragged batching infrastructure (reference: inference/v2/ragged/)."""
 
 from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
-from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.ragged.host_tier import (HostKVTier,
+                                                         HostTierStats)
+from deepspeed_tpu.inference.v2.ragged.kv_cache import (BlockedKVCache,
+                                                        dequantize_kv,
+                                                        quantize_kv)
 from deepspeed_tpu.inference.v2.ragged.prefix_cache import (PrefixCacheStats,
                                                             RadixPrefixCache)
 from deepspeed_tpu.inference.v2.ragged.ragged_manager import DSStateManager
@@ -11,5 +15,6 @@ from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import (
 )
 
 __all__ = ["BlockedAllocator", "BlockedKVCache", "DSStateManager",
-           "PrefixCacheStats", "RadixPrefixCache", "RaggedBatchWrapper",
-           "DSSequenceDescriptor"]
+           "HostKVTier", "HostTierStats", "PrefixCacheStats",
+           "RadixPrefixCache", "RaggedBatchWrapper",
+           "DSSequenceDescriptor", "quantize_kv", "dequantize_kv"]

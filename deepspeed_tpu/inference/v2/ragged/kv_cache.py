@@ -1,20 +1,76 @@
 """Blocked (paged) KV cache (reference: inference/v2/ragged/kv_cache.py
-``BlockedKVCache`` over CUDA block pools).
+``BlockedKVCache`` over CUDA block pools + the 2.4k-LoC compression
+subsystem's KV quantization, recast TPU-native).
 
 Device layout per layer: ``k/v: [num_blocks * block_size, Hkv, D]`` — a flat
 pool indexed by ``block_id * block_size + offset``. Ragged token writes are
 one scatter; per-sequence reads are one gather through the block table.
-XLA turns both into dynamic-slice/scatter fusions; a Pallas
-paged-attention kernel can later consume the same layout unchanged.
+XLA turns both into dynamic-slice/scatter fusions; the Pallas
+paged-attention kernels consume the same layout unchanged.
+
+**Quantized mode** (``dtype="int8"``): the pool stores symmetric int8
+payloads with fp32 scale records riding ALONGSIDE in the same tree —
+``k_scale/v_scale: [num_blocks * block_size, Hkv]``, one scale per pool
+row per kv head (quantization group = one head's D-vector, the same
+groupwise absmax/127 rule as ``ops/quantizer``'s symmetric int8 path).
+Because the scales share the pool's flat row indexing, every block
+operation — COW ``copy_block``, the ``gather_blocks``/``scatter_blocks``
+host handoff, the host cold tier's spool/restore — moves payload and
+scales together with zero special cases, and a restored block is
+bit-exact.  Prefill/decode writes quantize on cache insert
+(:func:`quantize_kv`); dequant happens in-kernel on the block walk
+(``kernels/blocked_flash.py``), never as a separate materialized pass.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+
+#: accepted ``kv_cache.dtype`` spellings -> pool storage dtype
+KV_DTYPES = {
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "f32": jnp.float32, "fp32": jnp.float32, "float32": jnp.float32,
+    "f16": jnp.float16, "float16": jnp.float16,
+    "int8": jnp.int8,
+}
+
+
+def resolve_kv_dtype(dtype: Any):
+    """Map a config string (``"bf16" | "int8" | ...``) or jnp dtype to
+    the pool storage dtype."""
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in KV_DTYPES:
+            raise ValueError(
+                f"kv_cache dtype {dtype!r} not understood — one of "
+                f"{sorted(KV_DTYPES)} (or a jnp dtype)")
+        return KV_DTYPES[key]
+    return dtype
+
+
+def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantize per (row, kv-head) group over the head
+    vector: ``x [..., Hkv, D] -> (q int8 same shape, scale fp32 [..., Hkv])``
+    with ``scale = absmax / 127`` (the ops/quantizer symmetric rule —
+    deterministic, so identical tokens always produce identical cache
+    content and greedy replay/restore parity is bitwise)."""
+    x32 = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray,
+                  dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`quantize_kv` (the XLA reference path; the hot
+    Pallas kernels fuse this into their block walk instead)."""
+    return (q.astype(jnp.float32)
+            * scale[..., None].astype(jnp.float32)).astype(dtype)
 
 
 class BlockedKVCache:
@@ -25,14 +81,28 @@ class BlockedKVCache:
         self.block_size = block_size
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
+        dtype = resolve_kv_dtype(dtype)
         self.dtype = dtype
+        #: int8 pools carry per-row/per-head fp32 scale records in-tree
+        self.quantized = jnp.dtype(dtype) == jnp.dtype(jnp.int8)
         flat = num_blocks * block_size
-        self.cache: Dict[str, Dict[str, jax.Array]] = {
-            f"layer_{i}": {
+
+        def layer():
+            leaves = {
                 "k": jnp.zeros((flat, num_kv_heads, head_dim), dtype),
                 "v": jnp.zeros((flat, num_kv_heads, head_dim), dtype),
             }
-            for i in range(num_layers)
+            if self.quantized:
+                # scale 1.0 on never-written rows: dequant of the zero
+                # payload stays zero, same as the unquantized pool
+                leaves["k_scale"] = jnp.ones((flat, num_kv_heads),
+                                             jnp.float32)
+                leaves["v_scale"] = jnp.ones((flat, num_kv_heads),
+                                             jnp.float32)
+            return leaves
+
+        self.cache: Dict[str, Dict[str, jax.Array]] = {
+            f"layer_{i}": layer() for i in range(num_layers)
         }
 
     # The engine threads self.cache through the jitted forward and stores the
@@ -87,8 +157,15 @@ class BlockedKVCache:
 
     @property
     def per_token_bytes(self) -> int:
+        """HBM bytes one cached token occupies across every layer — in
+        int8 mode the payload byte per element PLUS the fp32 scale record
+        per (row, head), so occupancy gauges and the roofline decode
+        bytes model never over-report bf16 bytes under quantization."""
         itemsize = jnp.dtype(self.dtype).itemsize
-        return 2 * self.num_layers * self.num_kv_heads * self.head_dim * itemsize
+        per_head = self.head_dim * itemsize
+        if self.quantized:
+            per_head += 4                       # fp32 scale per (row, head)
+        return 2 * self.num_layers * self.num_kv_heads * per_head
 
 
 @partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))

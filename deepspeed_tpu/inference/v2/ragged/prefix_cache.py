@@ -109,6 +109,13 @@ class RadixPrefixCache:
         self._clock = itertools.count(1)
         self._n_nodes = 0
         self.stats = PrefixCacheStats()
+        #: host cold tier hook (set by the state manager when
+        #: ``kv_cache.host_tier`` is on): called with the victim node
+        #: BEFORE its block is freed, while the device content and the
+        #: node's parent chain (its token-path key) are both still
+        #: intact — eviction then demotes the block to host RAM instead
+        #: of destroying it
+        self.spool_fn = None
         # incremental eviction state: node per cached block, plus a lazy-
         # deletion min-heap of (stamp, id, node) eviction candidates fed
         # by the allocator's refcount-drops-to-1 transitions — evict()
@@ -241,6 +248,44 @@ class RadixPrefixCache:
             registered += 1
         return registered, False
 
+    def node_tokens(self, node: _Node) -> Tuple[int, ...]:
+        """The full token prefix ``node``'s block completes (edge labels
+        root→node, concatenated) — the host tier's content key."""
+        parts = []
+        n = node
+        while n is not None and n.key is not None:
+            parts.append(n.key)
+            n = n.parent
+        return tuple(t for key in reversed(parts) for t in key)
+
+    def insert_restored(self, tokens: Sequence[int], block: int) -> None:
+        """Re-attach a host-restored block as the tree node covering
+        ``tokens`` (every parent block must already be cached — the
+        state manager restores root-outward, so tier hits always extend
+        an existing path).  The caller's freshly allocated refcount-1
+        reference BECOMES the tree reference — no ``acquire``; this is
+        the exact inverse of :meth:`evict`'s unwatch+free."""
+        bs = self.block_size
+        if len(tokens) % bs != 0 or not tokens:
+            raise ValueError(
+                f"insert_restored: key of {len(tokens)} tokens is not a "
+                f"whole number of {bs}-token blocks")
+        node = self._root
+        for i in range(len(tokens) // bs - 1):
+            key = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            node = node.children[key]
+        key = tuple(int(t) for t in tokens[-bs:])
+        if key in node.children:
+            raise ValueError(
+                "insert_restored: path already cached — a tier hit for "
+                "in-tree content means spool/restore accounting diverged")
+        child = _Node(key, int(block), node)
+        child.stamp = next(self._clock)
+        node.children[key] = child
+        self._by_block[int(block)] = child
+        self.allocator.watch(int(block))
+        self._n_nodes += 1
+
     # ------------------------------------------------------------------ #
     # Eviction
     # ------------------------------------------------------------------ #
@@ -305,6 +350,11 @@ class RadixPrefixCache:
                 victim.queued = True
                 heapq.heappush(heap, (victim.stamp, id(victim), victim))
                 continue
+            if self.spool_fn is not None:
+                # demote to the host tier before the device block is
+                # recycled (the node's parent chain is still intact, so
+                # the spool hook can derive its token-path key)
+                self.spool_fn(victim)
             del victim.parent.children[victim.key]
             del self._by_block[victim.block]
             self.allocator.unwatch(victim.block)

@@ -17,11 +17,14 @@ scheduler's admission view stays truthful.
 
 from __future__ import annotations
 
+import logging
+import time
 from typing import Dict, Iterable, List, Optional, Sequence
 
 from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig,
                                                   KVCacheConfig)
 from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.ragged.host_tier import HostKVTier
 from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
 from deepspeed_tpu.inference.v2.ragged.prefix_cache import RadixPrefixCache
 from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import (
@@ -47,13 +50,37 @@ class DSStateManager:
             num_blocks = config.max_ragged_sequence_count * per_seq + 1
         self.allocator = BlockedAllocator(num_blocks)
         kwargs = {}
-        if dtype is not None or kv_config.cache_dtype is not None:
+        # precedence: explicit kv_cache.dtype string > legacy cache_dtype
+        # > the model's compute dtype
+        if getattr(kv_config, "dtype", None) is not None:
+            kwargs["dtype"] = kv_config.dtype
+        elif dtype is not None or kv_config.cache_dtype is not None:
             kwargs["dtype"] = kv_config.cache_dtype or dtype
         self.kv_cache = BlockedKVCache(num_layers, num_blocks, self.block_size,
                                        num_kv_heads, head_dim, **kwargs)
         self.prefix_cache: Optional[RadixPrefixCache] = (
             RadixPrefixCache(self.allocator, self.block_size)
             if getattr(kv_config, "enable_prefix_cache", False) else None)
+        self.host_tier: Optional[HostKVTier] = None
+        if getattr(kv_config, "host_tier", False):
+            if self.prefix_cache is None:
+                raise ValueError(
+                    "kv_cache.host_tier requires enable_prefix_cache — "
+                    "cold blocks spool from the radix tree's LRU "
+                    "eviction path")
+            tier_bytes = getattr(kv_config, "host_tier_bytes", None)
+            if tier_bytes is None:
+                from deepspeed_tpu.utils.logging import log_dist
+
+                log_dist(
+                    "kv_cache.host_tier with host_tier_bytes unset: "
+                    "every LRU-evicted block spools to host RAM and "
+                    "stays until resumed — a long-running server with "
+                    "non-repeating prompts grows host RSS without "
+                    "bound; set kv_cache.host_tier_bytes to cap it",
+                    level=logging.WARNING)
+            self.host_tier = HostKVTier(max_bytes=tier_bytes)
+            self.prefix_cache.spool_fn = self._spool_node
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
 
     # ------------------------------------------------------------------ #
@@ -141,15 +168,31 @@ class DSStateManager:
         cache.stats.lookups += 1
         blocks = cache.match_blocks(tokens)
         usable = len(tokens) - 1
+        # Acquire the match BEFORE anything below can allocate (tier
+        # restores, cow fork): the matched blocks are tree-held at
+        # refcount 1, and an allocation under pressure evicts exactly
+        # such blocks — unprotected, a restore could recycle a block
+        # that is already in this match list (same rule the cow path
+        # states below).
+        self.allocator.acquire(blocks)
+        if self.host_tier is not None:
+            # extend the in-HBM match through the host cold tier: each
+            # tier hit restores a spooled block (bit-exact payload +
+            # scales) into a fresh device block and re-enters the tree,
+            # already holding the sequence's reference
+            blocks = blocks + self._restore_blocks(tokens, len(blocks),
+                                                   usable)
         bs = self.block_size
         cached = min(len(blocks) * bs, usable)
         n_keep = -(-cached // bs)
-        blocks = blocks[:n_keep]
+        # match_blocks covers only full blocks of `tokens` and restores
+        # stop at ceil(usable/bs), so the match can never exceed n_keep
+        # — every acquired reference above is kept
+        assert len(blocks) <= n_keep, (len(blocks), n_keep)
         if cached <= 0:
             cache.stats.misses += 1
             return 0
         cow = cached < n_keep * bs
-        self.allocator.acquire(blocks)
         fresh: Optional[int] = None
         if cow:
             # Allocate the fork target with the match already acquired
@@ -202,6 +245,72 @@ class DSStateManager:
         seq.shared_blocks += n
         if diverged:
             seq.register_stopped = True
+
+    # ------------------------------------------------------------------ #
+    # Host cold tier (kv_cache.host_tier): spool on LRU evict, restore
+    # on attach.  free_blocks stays truthful — tier entries are NOT HBM
+    # capacity; a restore consumes real free blocks through _allocate.
+    # ------------------------------------------------------------------ #
+    def _spool_node(self, node) -> None:
+        """Prefix-cache eviction hook: demote ``node``'s block to host
+        RAM (payload + scale records, one gather) keyed by the token
+        prefix it completes.  Runs on the allocation path under KV
+        pressure — never on a pressure-free steady-state decode tick."""
+        import jax
+
+        tokens = self.prefix_cache.node_tokens(node)
+        tier = self.host_tier
+        t0 = time.perf_counter()
+        payload = self.kv_cache.gather_blocks([node.block])
+        # gather_blocks device_gets, so the payload is host-resident
+        # here; the explicit no-op block marks the bracket's sync point
+        jax.block_until_ready(payload)
+        tier.stats.spool_s.append(time.perf_counter() - t0)
+        tier.put(tokens, payload)
+
+    def _restore_blocks(self, tokens: Sequence[int], depth: int,
+                        usable: int) -> List[int]:
+        """Pull spooled continuation blocks of ``tokens`` (tree depth
+        ``depth`` onward) back into HBM while they cover usable prompt
+        positions.  Each restore allocates through :meth:`_allocate`
+        (which may itself evict-and-spool colder blocks), scatters the
+        payload, re-enters the radix tree holding the fresh refcount-1
+        reference as the tree's own, and immediately acquires the
+        attaching sequence's reference on top — at refcount 2 a later
+        iteration's allocation can never evict a block this very match
+        is about to use (the caller has already acquired the in-HBM
+        prefix for the same reason)."""
+        import jax
+
+        tier = self.host_tier
+        cache = self.prefix_cache
+        bs = self.block_size
+        out: List[int] = []
+        i = depth
+        while i * bs < usable:
+            key = tuple(int(t) for t in tokens[:(i + 1) * bs])
+            payload = tier.get(key)
+            if payload is None:
+                break
+            try:
+                blk = self._allocate(1)[0]
+            except RuntimeError:
+                # HBM genuinely full even after eviction: the payload
+                # stays spooled (put back without recounting the spool)
+                tier.put(key, payload, count_spool=False)
+                break
+            t0 = time.perf_counter()
+            self.kv_cache.scatter_blocks([blk], payload)
+            # the scatter is async-dispatched; block so the restore
+            # latency stat measures the transfer, not the dispatch
+            jax.block_until_ready(self.kv_cache.cache)
+            tier.stats.restore_s.append(time.perf_counter() - t0)
+            tier.stats.restored_blocks += 1
+            cache.insert_restored(key, blk)
+            self.allocator.acquire([blk])
+            out.append(blk)
+            i += 1
+        return out
 
     def record_fed_tokens(self, seq: DSSequenceDescriptor, tokens) -> None:
         """Append host-known token values the engine just wrote KV for

@@ -377,8 +377,11 @@ def kv_occupancy(state_manager) -> Dict[str, float]:
     warm = len(alloc._watched)
     live_tokens = sum(s.seen_tokens
                       for s in state_manager._seqs.values())
+    # per_token_bytes is dtype-aware (int8 payload + scale records), so
+    # the byte gauges stay truthful under KV quantization instead of
+    # over-reporting bf16 bytes
     block_bytes = kv.block_size * kv.per_token_bytes
-    return {
+    out = {
         "observability/kv_blocks_total": float(total),
         "observability/kv_blocks_free": float(free),
         "observability/kv_blocks_live": float(total - free),
@@ -392,6 +395,25 @@ def kv_occupancy(state_manager) -> Dict[str, float]:
         "observability/kv_sequences_live": float(
             state_manager.n_tracked_sequences),
     }
+    tier = getattr(state_manager, "host_tier", None)
+    if tier is not None:
+        st = tier.stats
+        out.update({
+            # HBM-resident vs host-restorable capacity, separately
+            # gauged: tier entries never inflate kv_blocks_free — a
+            # restore consumes real free blocks
+            "observability/kv_host_tier_bytes": float(tier.bytes),
+            "observability/kv_host_tier_blocks": float(len(tier)),
+            "observability/kv_spooled_blocks": float(st.spooled_blocks),
+            "observability/kv_restored_blocks": float(st.restored_blocks),
+            "observability/kv_tier_dropped_blocks": float(
+                st.dropped_blocks),
+            "observability/kv_spool_p50_s": st.spool_pct(50),
+            "observability/kv_spool_p95_s": st.spool_pct(95),
+            "observability/kv_restore_p50_s": st.restore_pct(50),
+            "observability/kv_restore_p95_s": st.restore_pct(95),
+        })
+    return out
 
 
 def tree_bytes(tree) -> float:

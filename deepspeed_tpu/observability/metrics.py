@@ -29,6 +29,30 @@ def _declare(reg: MetricsRegistry) -> None:
     reg.gauge("observability/kv_*",
               help="KV pool occupancy: blocks live/warm/evictable, "
                    "token + byte gauges")
+    # host cold-tier gauges (kv_cache.host_tier): spooled/restored block
+    # counters, tier residency, and the spool/restore latency
+    # percentiles the session-mix bench reports — declared exactly (on
+    # top of the kv_* family) so the tier surface is self-documenting
+    reg.gauge("observability/kv_host_tier_bytes", unit="bytes",
+              help="bytes of KV spooled to the host cold tier")
+    reg.gauge("observability/kv_host_tier_blocks",
+              help="blocks currently resident in the host cold tier")
+    reg.counter("observability/kv_spooled_blocks",
+                help="blocks ever demoted HBM -> host tier")
+    reg.counter("observability/kv_restored_blocks",
+                help="blocks restored host tier -> HBM on attach/resume")
+    reg.counter("observability/kv_tier_dropped_blocks",
+                help="tier entries dropped past the host byte budget")
+    reg.gauge("observability/kv_spool_p50_s", unit="s",
+              help="spool (gather->host) latency p50 over a bounded "
+                   "window")
+    reg.gauge("observability/kv_spool_p95_s", unit="s",
+              help="spool latency p95")
+    reg.gauge("observability/kv_restore_p50_s", unit="s",
+              help="restore (host->scatter) latency p50, transfer "
+                   "blocked — not dispatch")
+    reg.gauge("observability/kv_restore_p95_s", unit="s",
+              help="restore latency p95")
     # per-tenant token occupancy over live requests
     reg.gauge("observability/tenant_tokens_*", unit="tokens",
               help="live token occupancy per tenant")

@@ -131,15 +131,26 @@ def decode_tick_costs(hidden: int, layers: int, heads: int, kv_heads: int,
                       intermediate: int, vocab: int, batch: int,
                       context: float, dtype: str = "bfloat16",
                       weight_dtype: Optional[str] = None,
+                      kv_dtype: Optional[str] = None,
                       phase: str = "decode") -> List[OpCost]:
     """Per-op costs of ONE batched decode tick: ``batch`` sequences, one
     token each, mean live context ``context``.  Decode is weight-stream
     + KV-read dominated; activation traffic ([batch, hidden] vectors) is
-    negligible and excluded."""
+    negligible and excluded.
+
+    ``kv_dtype`` prices the paged-attention KV read at the CACHE's
+    storage dtype (default: the activation dtype) — int8 counts the 1-
+    byte payload PLUS the fp32 scale record per (token, kv-head) the
+    fused-dequant kernel streams, so the waterfall stays truthful under
+    KV quantization."""
     head_dim = hidden // heads
     kv_dim = kv_heads * head_dim
     wb = _dtype_bytes(weight_dtype or dtype)
     ab = _dtype_bytes(dtype)
+    # KV bytes per (token, layer, k-or-v): payload + scale records
+    kv_rb = kv_dim * _dtype_bytes(kv_dtype or dtype)
+    if str(kv_dtype) == "int8":
+        kv_rb += kv_heads * 4              # fp32 scale per (row, head)
     S = batch
     qkv_w = hidden * (hidden + 2 * kv_dim)
     ops = [
@@ -150,7 +161,7 @@ def decode_tick_costs(hidden: int, layers: int, heads: int, kv_heads: int,
         # read (the O(live-context) stream the paged kernel performs)
         OpCost(f"attn/paged_attention x{layers}",
                flops=4.0 * S * context * hidden * layers,
-               bytes=float(2.0 * S * context * kv_dim * ab * layers),
+               bytes=float(2.0 * S * context * kv_rb * layers),
                phase=phase, peak_scale=min(head_dim, 128) / 128.0),
         OpCost(f"attn/o_proj x{layers}",
                flops=2.0 * S * hidden * hidden * layers,

@@ -198,6 +198,33 @@ def run_selftest(tol: float = 3e-2) -> dict:
                                block_size=bs, k_tokens=Kv,
                                interpret=False), wantv))
 
+    # int8 block-quantized decode + verify (kv_cache.dtype="int8"): the
+    # fused-dequant kernels against the XLA fallback over explicitly
+    # dequantized pools — same pools, same scales, so any divergence is
+    # the kernel's own dequant arithmetic
+    from deepspeed_tpu.inference.v2.ragged.kv_cache import (dequantize_kv,
+                                                            quantize_kv)
+
+    kq8, ks8 = quantize_kv(k_pool2)
+    vq8, vs8 = quantize_kv(v_pool2)
+    kd8 = dequantize_kv(kq8, ks8, jnp.float32)
+    vd8 = dequantize_kv(vq8, vs8, jnp.float32)
+    want8 = _paged_attention(q2, kd8, vd8, batch, bs, use_kernel=False)
+    guarded("paged_decode_dma_int8", lambda: record(
+        "paged_decode_dma_int8",
+        paged_decode_attention(q2, kq8, vq8, tables, token_slot,
+                               token_pos, block_size=bs,
+                               k_scale=ks8, v_scale=vs8,
+                               interpret=False), want8))
+
+    wantv8 = _paged_attention(qv, kd8, vd8, vbatch, bs, use_kernel=False)
+    guarded("paged_verify_multiquery_int8", lambda: record(
+        "paged_verify_multiquery_int8",
+        paged_verify_attention(qv, kq8, vq8, tables, vslot, vpos,
+                               block_size=bs, k_tokens=Kv,
+                               k_scale=ks8, v_scale=vs8,
+                               interpret=False), wantv8))
+
     # prefill: tile-aligned tokens for slot 0, at the ENGINE's shipped
     # 125M serving geometry (6 q heads / 2 kv heads — the exact kernel
     # instantiation bench_serving.py runs)

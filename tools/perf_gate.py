@@ -51,6 +51,11 @@ KNOWN_RECORD_SPECS: Dict[str, List[Tuple[str, str]]] = {
         ("value", "higher"), ("extra.decode_step_ms", "lower")],
     "serving_scheduler_goodput_tokens_per_sec": [("value", "higher")],
     "fastgen_7b_int8_decode_tokens_per_sec": [("value", "higher")],
+    # session-mix capacity (int8 KV + host tier): resident sessions and
+    # the vs-bf16-baseline ratio are both higher-is-better — a PR that
+    # silently shrinks either regresses the million-session thesis
+    "serving_session_mix_resident_sessions": [
+        ("value", "higher"), ("vs_baseline", "higher")],
 }
 
 

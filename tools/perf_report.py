@@ -147,6 +147,9 @@ def build_decode_waterfall(record: dict,
         intermediate=int(geo["intermediate"]), vocab=int(geo["vocab"]),
         batch=batch, context=context,
         dtype=geo.get("dtype", extra.get("dtype", "bfloat16")),
+        # records from quantized-KV runs carry the cache dtype so the
+        # KV-read row prices int8 payload + scale bytes, not bf16
+        kv_dtype=geo.get("kv_dtype"),
         phase=engine_phase)
     child_phases = sorted(p for p in phases if p != "tick")
     if child_phases and not phases.get(engine_phase):
