@@ -71,13 +71,10 @@ def peak_flops_per_chip() -> float:
     return chip_specs("" if "cpu" in kind else kind)[0]
 
 
-def _measure(heads: int, micro_batch: int, seq: int,
-             attention_layout: str = "bshd", ledger_out: dict = None):
-    """One training-throughput measurement at the given head geometry.
-    Returns (tokens/s/chip, mfu, loss, step_ms, n_params, n_dev).
-    With ``ledger_out`` (a dict), the engine's compiled train programs'
-    HLO memory/cost analysis is recorded into it (explicit
-    ``unavailable`` on failure) — the BENCH JSON's memory evidence."""
+def _build_train(heads: int, micro_batch: int, seq: int,
+                 attention_layout: str):
+    """One warm train-step closure at the given geometry/layout:
+    returns (engine, step, hard_sync, batch, n_dev, vocab)."""
     import jax
     import jax.numpy as jnp
 
@@ -96,7 +93,9 @@ def _measure(heads: int, micro_batch: int, seq: int,
         "bf16": {"enabled": True},
         "gradient_clipping": 1.0,
         # "folded" = layout-native attention ([B,S,H*D] end to end, no
-        # BSHD<->BHSD transposes) — exercises the runtime-config plumbing
+        # BSHD<->BHSD transposes); "paired" additionally packs d<128
+        # heads into lane-full MXU tiles — exercises the runtime-config
+        # plumbing either way
         "attention_layout": attention_layout,
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=LlamaForCausalLM(cfg_m),
@@ -119,6 +118,21 @@ def _measure(heads: int, micro_batch: int, seq: int,
         parameter update."""
         leaf = jax.tree_util.tree_leaves(engine.state["master"])[0]
         return jax.device_get(jnp.ravel(leaf)[0])
+
+    return engine, step, hard_sync, batch, n_dev, cfg_m
+
+
+def _measure(heads: int, micro_batch: int, seq: int,
+             attention_layout: str = "bshd", ledger_out: dict = None):
+    """One training-throughput measurement at the given head geometry.
+    Returns (tokens/s/chip, mfu, loss, step_ms, n_params, n_dev).
+    With ``ledger_out`` (a dict), the engine's compiled train programs'
+    HLO memory/cost analysis is recorded into it (explicit
+    ``unavailable`` on failure) — the BENCH JSON's memory evidence."""
+    import jax
+
+    engine, step, hard_sync, batch, n_dev, cfg_m = _build_train(
+        heads, micro_batch, seq, attention_layout)
 
     # warmup + compile
     for _ in range(3):
@@ -157,6 +171,57 @@ def _measure(heads: int, micro_batch: int, seq: int,
     mfu = tokens_per_sec_per_chip * flops_per_token / peak_flops_per_chip()
     return (tokens_per_sec_per_chip, mfu, float(jax.device_get(loss)),
             1000 * dt / iters, n_params, n_dev)
+
+
+def measure_paired_ab(heads: int = 12, micro_batch: int = 8,
+                      seq: int = 1024, windows: int = 5,
+                      iters_per_window: int = 4) -> dict:
+    """Paired-vs-folded attention A/B on the honest 12-head/d64
+    geometry, INTERLEAVED per the perf_gate methodology: both arms'
+    engines are built and warmed first, then timed in alternating
+    windows (F P F P ...) — two sequential single-arm windows each
+    self-report a clean intra-window noise floor yet drift wholesale
+    when host load shifts between them (PERFLOG r16).  Reports the
+    per-arm median-of-window step times, the paired/folded ratio, and
+    the cross-window ratio spread as the record's ``noise_pct``."""
+    import math
+
+    arms = ("folded", "paired")
+    steps, syncs = {}, {}
+    for layout in arms:
+        _, step, hard_sync, _, _, _ = _build_train(
+            heads, micro_batch, seq, layout)
+        for _ in range(3):          # warm + compile both arms up front
+            step()
+        hard_sync()
+        steps[layout], syncs[layout] = step, hard_sync
+    times = {a: [] for a in arms}
+    for _ in range(windows):
+        for layout in arms:
+            t0 = time.perf_counter()
+            for _ in range(iters_per_window):
+                steps[layout]()
+            syncs[layout]()
+            times[layout].append(
+                (time.perf_counter() - t0) / iters_per_window)
+    med = {a: float(np.median(times[a])) for a in arms}
+    ratios = [p / f for p, f in zip(times["paired"], times["folded"])]
+    ratio = float(np.median(ratios))
+    noise_pct = 100.0 * (max(ratios) - min(ratios)) / 2.0 \
+        if len(ratios) > 1 else 0.0
+    if not all(math.isfinite(med[a]) and med[a] > 0 for a in arms):
+        raise RuntimeError(f"paired A/B produced degenerate timings {med}")
+    return {
+        "heads": heads, "head_dim": 768 // heads,
+        "micro_batch": micro_batch, "seq": seq,
+        "interleaved_windows": windows,
+        "iters_per_window": iters_per_window,
+        "folded": {"step_time_ms": round(1000 * med["folded"], 3)},
+        "paired": {"step_time_ms": round(1000 * med["paired"], 3)},
+        # < 1.0 = paired beat folded on this host/chip
+        "ratio_vs_folded": round(ratio, 4),
+        "noise_pct": round(noise_pct, 2),
+    }
 
 
 def _enable_compile_cache():
@@ -325,6 +390,25 @@ def main():
         else:
             folded_geom = {"note": "skipped: bench time budget"}
 
+    # Paired-vs-folded A/B on the honest d64 geometry (ROADMAP item 2's
+    # head-pairing fix): interleaved arms per the perf_gate methodology,
+    # TPU-only and budget-guarded like the folded A/B above — a Mosaic
+    # failure in the paired kernels must not cost the headline.
+    paired_ab = None
+    if devs[0].platform == "tpu":
+        if elapsed() < 500:
+            try:
+                with _stage("bench/paired_ab"):
+                    paired_ab = measure_paired_ab(
+                        heads=HEADLINE_HEADS, micro_batch=HEADLINE_MB,
+                        seq=seq)
+            except Exception as e:  # noqa: BLE001
+                paired_ab = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# paired-layout A/B done at {elapsed():.0f}s",
+                  file=sys.stderr)
+        else:
+            paired_ab = {"note": "skipped: bench time budget"}
+
     # --- HLO memory ledger: the 7B ZeRO-3 VIRTUAL-MESH compile evidence
     # (ROADMAP item 3) — abstract lowering in a CPU subprocess (no
     # weights materialised, the parent's TPU backend untouched), bounded
@@ -378,6 +462,7 @@ def main():
             "memory_ledger": {"schema": "ds-memory-ledger-v1",
                               "entries": mem_entries},
             **({"folded_attention": folded_geom} if folded_geom else {}),
+            **({"paired_attention": paired_ab} if paired_ab else {}),
             **({"tpu_geometry": tpu_geom} if tpu_geom else {}),
             "serving_7b": serving_7b,
             "kernel_selftest": selftest,
@@ -389,6 +474,31 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--paired-ab" in sys.argv:
+        # standalone paired-vs-folded train microbench: one JSON record
+        # in the perf_gate shape (tools/perf_gate.py
+        # train_paired_attention_ab spec gates value + ratio, margin
+        # widened by the record's own interleaved-arm noise_pct)
+        try:
+            _enable_compile_cache()
+            ab = measure_paired_ab()
+            print(json.dumps({
+                "metric": "train_paired_attention_ab",
+                "value": ab["paired"]["step_time_ms"],
+                "unit": "ms/step",
+                "vs_baseline": ab["ratio_vs_folded"],
+                "extra": ab,
+            }))
+            sys.exit(0)
+        except Exception as e:  # noqa: BLE001 — always emit a record
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({"metric": "train_paired_attention_ab",
+                              "value": 0, "unit": "ms/step",
+                              "vs_baseline": 0,
+                              "error": f"{type(e).__name__}: {e}"}))
+            sys.exit(0)
     try:
         main()
     except Exception as e:  # noqa: BLE001 — always emit a JSON record

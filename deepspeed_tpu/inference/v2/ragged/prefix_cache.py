@@ -110,11 +110,13 @@ class RadixPrefixCache:
         self._n_nodes = 0
         self.stats = PrefixCacheStats()
         #: host cold tier hook (set by the state manager when
-        #: ``kv_cache.host_tier`` is on): called with the victim node
-        #: BEFORE its block is freed, while the device content and the
-        #: node's parent chain (its token-path key) are both still
-        #: intact — eviction then demotes the block to host RAM instead
-        #: of destroying it
+        #: ``kv_cache.host_tier`` is on): called ONCE per evict() with
+        #: the whole victim-node list BEFORE their blocks are freed,
+        #: while the device content and each node's parent chain (its
+        #: token-path key) are both still intact — eviction then
+        #: demotes all victims to host RAM in one gather dispatch
+        #: instead of destroying them (or paying per-block
+        #: dispatch+sync serially)
         self.spool_fn = None
         # incremental eviction state: node per cached block, plus a lazy-
         # deletion min-heap of (stamp, id, node) eviction candidates fed
@@ -335,9 +337,17 @@ class RadixPrefixCache:
         allocator's refcount-drops-to-1 callback and by parent exposure
         here — so a call under steady KV pressure is O(want log nodes)
         plus lazy-deletion skips, never a tree walk (this runs on every
-        block allocation once the pool is warm)."""
+        block allocation once the pool is warm).
+
+        With a host tier attached, every victim of this call is handed
+        to ``spool_fn`` as ONE list — one ``gather_blocks`` dispatch +
+        one sync moves the whole batch to host RAM (the per-block
+        dispatch cost at ~3-5 ms each made a multi-block eviction pay
+        serially) — and the device blocks are freed afterwards in one
+        allocator call."""
         freed = 0
         heap = self._evict_heap
+        victims: List[_Node] = []
         while freed < want and heap:
             stamp, _, victim = heapq.heappop(heap)
             victim.queued = False
@@ -350,17 +360,14 @@ class RadixPrefixCache:
                 victim.queued = True
                 heapq.heappush(heap, (victim.stamp, id(victim), victim))
                 continue
-            if self.spool_fn is not None:
-                # demote to the host tier before the device block is
-                # recycled (the node's parent chain is still intact, so
-                # the spool hook can derive its token-path key)
-                self.spool_fn(victim)
+            # detach from the tree now (victim.parent stays intact, so
+            # the spool hook can still derive the token-path key below)
             del victim.parent.children[victim.key]
             del self._by_block[victim.block]
             self.allocator.unwatch(victim.block)
-            self.allocator.free([victim.block])
             self._n_nodes -= 1
             self.stats.evicted_blocks += 1
+            victims.append(victim)
             freed += 1
             parent = victim.parent
             if (parent is not self._root and not parent.children
@@ -368,4 +375,10 @@ class RadixPrefixCache:
                     and self.allocator.refcount(parent.block) == 1):
                 parent.queued = True
                 heapq.heappush(heap, (parent.stamp, id(parent), parent))
+        if victims:
+            if self.spool_fn is not None:
+                # demote the whole batch to the host tier before the
+                # device blocks are recycled
+                self.spool_fn(victims)
+            self.allocator.free([v.block for v in victims])
         return freed

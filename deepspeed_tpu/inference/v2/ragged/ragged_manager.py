@@ -80,7 +80,7 @@ class DSStateManager:
                     "bound; set kv_cache.host_tier_bytes to cap it",
                     level=logging.WARNING)
             self.host_tier = HostKVTier(max_bytes=tier_bytes)
-            self.prefix_cache.spool_fn = self._spool_node
+            self.prefix_cache.spool_fn = self._spool_nodes
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
 
     # ------------------------------------------------------------------ #
@@ -251,66 +251,105 @@ class DSStateManager:
     # on attach.  free_blocks stays truthful — tier entries are NOT HBM
     # capacity; a restore consumes real free blocks through _allocate.
     # ------------------------------------------------------------------ #
-    def _spool_node(self, node) -> None:
-        """Prefix-cache eviction hook: demote ``node``'s block to host
-        RAM (payload + scale records, one gather) keyed by the token
-        prefix it completes.  Runs on the allocation path under KV
-        pressure — never on a pressure-free steady-state decode tick."""
+    def _spool_nodes(self, nodes) -> None:
+        """Prefix-cache eviction hook: demote the whole victim batch to
+        host RAM — ONE ``gather_blocks`` dispatch + ONE sync for every
+        victim block (the per-block dispatch cost at ~3-5 ms each made
+        multi-block evictions pay serially), then split the host
+        payload per block, each keyed by the token prefix it completes.
+        Runs on the allocation path under KV pressure — never on a
+        pressure-free steady-state decode tick."""
         import jax
 
-        tokens = self.prefix_cache.node_tokens(node)
+        cache = self.prefix_cache
         tier = self.host_tier
+        bs = self.block_size
+        # keys read the parent chains BEFORE anything else — evict()
+        # guarantees they are intact at hook time
+        keys = [cache.node_tokens(n) for n in nodes]
         t0 = time.perf_counter()
-        payload = self.kv_cache.gather_blocks([node.block])
+        payload = self.kv_cache.gather_blocks([n.block for n in nodes])
         # gather_blocks device_gets, so the payload is host-resident
         # here; the explicit no-op block marks the bracket's sync point
         jax.block_until_ready(payload)
         tier.stats.spool_s.append(time.perf_counter() - t0)
-        tier.put(tokens, payload)
+        tier.stats.spool_blocks_per_call.append(len(nodes))
+        import numpy as np
+
+        for i, key in enumerate(keys):
+            # row order follows the block list, so victim i's rows are
+            # exactly [i*bs, (i+1)*bs).  COPY the slice (a bare or
+            # ascontiguousarray'd slice is a VIEW — it would pin the
+            # whole N-block gather buffer, so the tier's byte budget
+            # could drop entries without releasing any memory)
+            part = jax.tree_util.tree_map(
+                lambda a, i=i: np.array(a[i * bs:(i + 1) * bs]), payload)
+            tier.put(key, part)
 
     def _restore_blocks(self, tokens: Sequence[int], depth: int,
                         usable: int) -> List[int]:
         """Pull spooled continuation blocks of ``tokens`` (tree depth
         ``depth`` onward) back into HBM while they cover usable prompt
-        positions.  Each restore allocates through :meth:`_allocate`
-        (which may itself evict-and-spool colder blocks), scatters the
-        payload, re-enters the radix tree holding the fresh refcount-1
-        reference as the tree's own, and immediately acquires the
-        attaching sequence's reference on top — at refcount 2 a later
-        iteration's allocation can never evict a block this very match
-        is about to use (the caller has already acquired the in-HBM
-        prefix for the same reason)."""
+        positions.  The whole contiguous run of tier hits restores in
+        ONE ``scatter_blocks`` dispatch + ONE sync: hits are popped
+        first, their device blocks allocated in one :meth:`_allocate`
+        call (which may itself evict-and-spool colder blocks — also
+        batched now), the payloads concatenated and scattered together,
+        then each block re-enters the radix tree holding the fresh
+        refcount-1 reference as the tree's own with the attaching
+        sequence's reference acquired on top.  Nothing allocates
+        between the scatter and those acquires, so no eviction can
+        recycle a block this very match is about to use (the caller
+        has already acquired the in-HBM prefix for the same reason).
+        Hits HBM cannot admit go straight back to the tier (never
+        recounted as spools)."""
         import jax
+        import numpy as np
 
         tier = self.host_tier
         cache = self.prefix_cache
         bs = self.block_size
-        out: List[int] = []
+        # pop the whole contiguous run of tier hits
+        keys: List[tuple] = []
+        payloads: List[dict] = []
         i = depth
         while i * bs < usable:
             key = tuple(int(t) for t in tokens[:(i + 1) * bs])
             payload = tier.get(key)
             if payload is None:
                 break
+            keys.append(key)
+            payloads.append(payload)
+            i += 1
+        if not keys:
+            return []
+        # allocate for as many hits as HBM admits (deepest-first
+        # surrender keeps the restored span a contiguous prefix)
+        blks: List[int] = []
+        while keys:
             try:
-                blk = self._allocate(1)[0]
-            except RuntimeError:
-                # HBM genuinely full even after eviction: the payload
-                # stays spooled (put back without recounting the spool)
-                tier.put(key, payload, count_spool=False)
+                blks = self._allocate(len(keys))
                 break
-            t0 = time.perf_counter()
-            self.kv_cache.scatter_blocks([blk], payload)
-            # the scatter is async-dispatched; block so the restore
-            # latency stat measures the transfer, not the dispatch
-            jax.block_until_ready(self.kv_cache.cache)
-            tier.stats.restore_s.append(time.perf_counter() - t0)
-            tier.stats.restored_blocks += 1
+            except RuntimeError:
+                tier.put(keys.pop(), payloads.pop(), count_spool=False)
+        if not blks:
+            return []
+        merged = (payloads[0] if len(payloads) == 1 else
+                  jax.tree_util.tree_map(
+                      lambda *parts: np.concatenate(parts, axis=0),
+                      *payloads))
+        t0 = time.perf_counter()
+        self.kv_cache.scatter_blocks(blks, merged)
+        # the scatter is async-dispatched; block so the restore
+        # latency stat measures the transfer, not the dispatch
+        jax.block_until_ready(self.kv_cache.cache)
+        tier.stats.restore_s.append(time.perf_counter() - t0)
+        tier.stats.restore_blocks_per_call.append(len(blks))
+        tier.stats.restored_blocks += len(blks)
+        for key, blk in zip(keys, blks):
             cache.insert_restored(key, blk)
             self.allocator.acquire([blk])
-            out.append(blk)
-            i += 1
-        return out
+        return blks
 
     def record_fed_tokens(self, seq: DSSequenceDescriptor, tokens) -> None:
         """Append host-known token values the engine just wrote KV for

@@ -36,14 +36,39 @@ class SpeculativeConfig:
     self-drafter; pass :func:`make_self_drafter`'s result to key drafts
     off the radix prefix cache, or a :class:`SmallModelDrafter` for a
     draft model.
+
+    **Acceptance-aware K autotuning** (``autotune_k=True``): the
+    scheduler keeps a per-request EWMA of the accept RATE (accepted /
+    drafted per verify pass, smoothing ``accept_ewma_alpha``) and walks
+    that request's effective K one step per pass — below
+    ``shrink_threshold`` toward ``min_draft_k`` (a low-acceptance
+    request stops paying K-token verify flops it never cashes), above
+    ``grow_threshold`` back toward ``draft_k``.  ``draft_k`` stays the
+    CAP, so the verify program shapes remain the bounded per-K set the
+    engine already compiles.
     """
 
     draft_k: int = 4
     drafter: Optional[Drafter] = None
+    autotune_k: bool = False
+    min_draft_k: int = 1
+    accept_ewma_alpha: float = 0.3
+    shrink_threshold: float = 0.35
+    grow_threshold: float = 0.65
 
     def __post_init__(self):
         if self.draft_k < 1:
             raise ValueError("draft_k must be >= 1")
+        if not 1 <= self.min_draft_k <= self.draft_k:
+            raise ValueError(
+                f"min_draft_k must be in [1, draft_k={self.draft_k}], "
+                f"got {self.min_draft_k}")
+        if not 0.0 < self.accept_ewma_alpha <= 1.0:
+            raise ValueError("accept_ewma_alpha must be in (0, 1]")
+        if not 0.0 <= self.shrink_threshold <= self.grow_threshold <= 1.0:
+            raise ValueError(
+                "need 0 <= shrink_threshold <= grow_threshold <= 1, got "
+                f"({self.shrink_threshold}, {self.grow_threshold})")
         if self.drafter is None:
             self.drafter = NgramDrafter()
 
@@ -57,6 +82,8 @@ class SpeculativeStats:
     drafted: int = 0          # draft tokens proposed into verify passes
     accepted: int = 0         # draft tokens accepted
     emitted: int = 0          # tokens emitted by verify passes
+    k_sum: int = 0            # per-request effective-K targets, summed
+    k_requests: int = 0       # request slots the targets were summed over
 
     @property
     def accept_rate(self) -> float:
@@ -67,6 +94,13 @@ class SpeculativeStats:
         """Mean tokens emitted per verify weight pass (>= 1)."""
         return self.emitted / max(self.ticks, 1)
 
+    @property
+    def k_effective(self) -> float:
+        """Mean per-request draft-K target across verify passes — with
+        ``autotune_k`` this decays below ``draft_k`` exactly as far as
+        acceptance decays (``serving/spec_k_effective``)."""
+        return self.k_sum / max(self.k_requests, 1)
+
     def as_dict(self) -> Dict[str, float]:
         return {
             "ticks": float(self.ticks),
@@ -76,6 +110,7 @@ class SpeculativeStats:
             "emitted": float(self.emitted),
             "accept_rate": self.accept_rate,
             "tokens_per_pass": self.tokens_per_pass,
+            "k_effective": self.k_effective,
         }
 
 

@@ -20,6 +20,7 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.models.llama import cross_entropy_loss
 from deepspeed_tpu.ops.attention import (dot_product_attention,
                                          folded_attention,
+                                         paired_attention,
                                          resolve_attention_layout)
 
 
@@ -38,10 +39,12 @@ class GPT2Config:
     intermediate_size: Any = None
     dtype: Any = jnp.bfloat16
     remat: bool = False
-    # "folded" | "bshd" | None (None -> the process default set from the
-    # DeepSpeed config's top-level `attention_layout` key). "folded" keeps
-    # attention in the c_attn GEMM's [B,S,H*D] layout — no BSHD<->BHSD
-    # transposes around the flash kernel.
+    # "paired" | "folded" | "bshd" | None (None -> the process default set
+    # from the DeepSpeed config's top-level `attention_layout` key).
+    # "folded" keeps attention in the c_attn GEMM's [B,S,H*D] layout — no
+    # BSHD<->BHSD transposes around the flash kernel; "paired" adds
+    # in-kernel head pairing so d=64 heads run full-lane MXU dots
+    # (falls back to folded/bshd where pairing does not apply).
     attention_layout: Any = None
 
     @property
@@ -91,10 +94,13 @@ class GPT2Block(nn.Module):
         y = ln("ln_1")(x)
         qkv = dense(3 * cfg.hidden_size, "c_attn")(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        if resolve_attention_layout(cfg.attention_layout) == "folded":
+        layout = resolve_attention_layout(cfg.attention_layout)
+        if layout in ("folded", "paired"):
             # consume the c_attn GEMM output directly ([B,S,H*D] end to
             # end); ineligible geometries fall back inside
-            out = folded_attention(q, k, v, num_heads=h, causal=True)
+            attn_fn = paired_attention if layout == "paired" \
+                else folded_attention
+            out = attn_fn(q, k, v, num_heads=h, causal=True)
         else:
             reshape = lambda t: t.reshape(*t.shape[:2], h, d)
             out = dot_product_attention(reshape(q), reshape(k), reshape(v),

@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.ops.attention import (dot_product_attention,
                                          folded_attention,
+                                         paired_attention,
                                          resolve_attention_layout)
 
 
@@ -54,11 +55,14 @@ class LlamaConfig:
     # Mistral-style sliding-window attention: each token attends to at
     # most the previous `sliding_window` positions (None = full causal).
     sliding_window: Any = None
-    # "folded" | "bshd" | None (None -> the process default set from the
-    # DeepSpeed config's top-level `attention_layout` key). "folded" keeps
-    # the training attention path in the projection GEMMs' [B,S,H*D] lane
-    # layout — no BSHD<->BHSD transposes around the flash kernel (the
-    # 13.8 ms layout tax of the 86 ms honest-geometry step, PERFLOG r5).
+    # "paired" | "folded" | "bshd" | None (None -> the process default set
+    # from the DeepSpeed config's top-level `attention_layout` key).
+    # "folded" keeps the training attention path in the projection GEMMs'
+    # [B,S,H*D] lane layout — no BSHD<->BHSD transposes around the flash
+    # kernel (the 13.8 ms layout tax of the 86 ms honest-geometry step,
+    # PERFLOG r5); "paired" adds in-kernel head pairing so d<128 heads
+    # run full-lane MXU dots (ineligible geometries fall back to
+    # folded/bshd per call).
     attention_layout: Any = None
 
     @property
@@ -173,13 +177,16 @@ class LlamaAttention(nn.Module):
                   if cfg.sliding_window is not None and
                   x.shape[1] > cfg.sliding_window else None)
 
+        layout = resolve_attention_layout(cfg.attention_layout)
         if (cache is None and attention_fn is None and
-                resolve_attention_layout(cfg.attention_layout) == "folded"):
+                layout in ("folded", "paired")):
             # layout-native training path: [B,S,H,D] here is a free
             # reshape of the projection output, so folding back costs
             # nothing — the kernel consumes [B,S,H*D] directly and no
             # transpose appears in forward or backward
-            out = folded_attention(
+            layout_fn = paired_attention if layout == "paired" \
+                else folded_attention
+            out = layout_fn(
                 q.reshape(*x.shape[:2], h * d),
                 k.reshape(*x.shape[:2], hkv * d),
                 v.reshape(*x.shape[:2], hkv * d),

@@ -412,6 +412,12 @@ def kv_occupancy(state_manager) -> Dict[str, float]:
             "observability/kv_spool_p95_s": st.spool_pct(95),
             "observability/kv_restore_p50_s": st.restore_pct(50),
             "observability/kv_restore_p95_s": st.restore_pct(95),
+            # batched tier traffic: blocks moved per gather/scatter
+            # dispatch (p50 ~1 means the batching never engages)
+            "observability/kv_spool_blocks_per_call_p50":
+                st.spool_blocks_pct(50),
+            "observability/kv_restore_blocks_per_call_p50":
+                st.restore_blocks_pct(50),
         })
     return out
 

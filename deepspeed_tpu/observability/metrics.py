@@ -53,6 +53,10 @@ def _declare(reg: MetricsRegistry) -> None:
                    "blocked — not dispatch")
     reg.gauge("observability/kv_restore_p95_s", unit="s",
               help="restore latency p95")
+    reg.gauge("observability/kv_spool_blocks_per_call_p50",
+              help="blocks demoted per batched gather dispatch (p50)")
+    reg.gauge("observability/kv_restore_blocks_per_call_p50",
+              help="blocks restored per batched scatter dispatch (p50)")
     # per-tenant token occupancy over live requests
     reg.gauge("observability/tenant_tokens_*", unit="tokens",
               help="live token occupancy per tenant")

@@ -185,6 +185,7 @@ def train_step_costs(hidden: int, layers: int, heads: int,
                      intermediate: int, vocab: int, batch: int, seq: int,
                      dtype: str = "bfloat16", n_params: Optional[int] = None,
                      optimizer_state_bytes_per_param: int = 16,
+                     attention_layout: str = "bshd",
                      phase: str = "train") -> List[OpCost]:
     """Per-op costs of ONE fwd+bwd+optimizer training step (the bench.py
     headline).  Matmul FLOPs carry the standard 3x fwd factor (1x
@@ -197,8 +198,19 @@ def train_step_costs(hidden: int, layers: int, heads: int,
     #: a d<128 attention GEMM underfills the 128-wide MXU lanes — its
     #: compute ceiling is proportionally lower (d64 ⇒ 0.5 peak).  THIS
     #: is the honest-geometry gap's named culprit: every other GEMM in
-    #: the step contracts over >=768 lanes.
-    lane_scale = min(head_dim, 128) / 128.0
+    #: the step contracts over >=768 lanes.  The "paired" attention
+    #: layout removes exactly this ceiling: 128/d heads share one
+    #: lane-full [block, 128] tile per MXU pass, so the paired d64 row
+    #: runs at FULL peak (the waterfall shows the ceiling moving).
+    #: mirror paired_heads_per_block's eligibility (MHA form — this
+    #: model has no kv_heads input): an ineligible geometry falls back
+    #: to the folded kernel at runtime, so granting it full lanes here
+    #: would hide the very gap this model exists to name
+    m_pack = 128 // max(head_dim, 1)
+    paired = (attention_layout == "paired" and head_dim < 128
+              and head_dim % 8 == 0 and 128 % max(head_dim, 1) == 0
+              and m_pack <= 8 and heads % max(m_pack, 1) == 0)
+    lane_scale = 1.0 if paired else min(head_dim, 128) / 128.0
     wb = _dtype_bytes(dtype)
     ab = _dtype_bytes(dtype)
     B, S = batch, seq
@@ -215,7 +227,8 @@ def train_step_costs(hidden: int, layers: int, heads: int,
     ops = [
         gemm(f"attn/qkv_proj x{layers}", qkv_w * layers,
              2.0 * T * qkv_w * layers, 4 * layers),
-        OpCost(f"attn/flash_attention(d{head_dim}) x{layers}",
+        OpCost(f"attn/flash_attention(d{head_dim}"
+               f"{',paired' if paired else ''}) x{layers}",
                # q·K^T + att·V, causal (x0.5), fwd+bwd recompute (~3.5x
                # of the two fwd GEMMs is the flash bwd's standard count)
                flops=3.5 * (2.0 * 2.0 * B * S * S * hidden * 0.5) * layers,

@@ -24,7 +24,13 @@ import jax.numpy as jnp
 #           transposes (PERFLOG round 5: 13.8 ms of the 86 ms honest-
 #           geometry step). Falls back to the bshd path per-call for
 #           geometries the folded kernel doesn't support.
-ATTENTION_LAYOUTS = ("bshd", "folded")
+# "paired": the folded boundary PLUS head pairing inside the kernel — at
+#           head_dim < 128 (the honest GPT-2 d=64 geometry) 128/D heads
+#           share one lane-full [block, 128] tile per MXU pass, lifting
+#           the half-lane compute ceiling the roofline model names.
+#           Falls back per-call to folded (D >= 128 is already
+#           lane-full) and from there to bshd.
+ATTENTION_LAYOUTS = ("bshd", "folded", "paired")
 _DEFAULT_ATTENTION_LAYOUT = "bshd"
 
 
@@ -124,6 +130,47 @@ def folded_attention(q, k, v, *, num_heads: int,
         v.reshape(b, sk, hkv, d), causal=causal, scale=scale, window=window,
         implementation="auto" if implementation == "pallas" else implementation)
     return out.reshape(b, sq, hd)
+
+
+def paired_attention(q, k, v, *, num_heads: int,
+                     num_kv_heads: Optional[int] = None,
+                     causal: bool = True,
+                     scale: Optional[float] = None,
+                     window: Optional[int] = None,
+                     implementation: str = "auto"):
+    """Head-paired attention on the QKV GEMM's folded output.
+
+    q: [B,Sq,H*D]; k/v: [B,Sk,Hkv*D]; returns [B,Sq,H*D].  When head
+    pairing applies (D < 128 dividing 128, even head groups) the paired
+    Pallas kernel runs every MXU dot at full 128 lanes
+    (``implementation='pallas'`` forces it, 'auto' gates on
+    :func:`flash_attention_paired_usable`).  Every other geometry —
+    D >= 128 (already lane-full) or odd head counts with no pad rule —
+    falls through to :func:`folded_attention`, which itself falls back
+    to the bshd path, so routing never fails."""
+    hkv = num_kv_heads if num_kv_heads is not None else num_heads
+    if implementation in ("auto", "pallas"):
+        try:
+            from deepspeed_tpu.ops.flash_attention import (
+                flash_attention_paired, flash_attention_paired_usable,
+                paired_heads_per_block)
+        except ImportError:
+            if implementation == "pallas":
+                raise  # an explicit kernel request must not silently degrade
+        else:
+            d = q.shape[-1] // num_heads if q.ndim == 3 and \
+                q.shape[-1] % num_heads == 0 else 0
+            pairable = d and paired_heads_per_block(num_heads, hkv,
+                                                    d) is not None
+            if pairable and (implementation == "pallas" or
+                             flash_attention_paired_usable(
+                                 q, k, v, num_heads, hkv, causal, None)):
+                return flash_attention_paired(
+                    q, k, v, num_heads=num_heads, num_kv_heads=hkv,
+                    causal=causal, scale=scale, window=window)
+    return folded_attention(q, k, v, num_heads=num_heads, num_kv_heads=hkv,
+                            causal=causal, scale=scale, window=window,
+                            implementation=implementation)
 
 
 def _xla_attention(q, k, v, *, causal, mask, scale, window=None, bias=None):

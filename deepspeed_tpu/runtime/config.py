@@ -287,8 +287,11 @@ class DeepSpeedConfig:
         self.dataloader_drop_last = pd.get("dataloader_drop_last", False)
         self.seed = pd.get("seed", 1234)
         # "folded" keeps attention in the QKV GEMM's [B,S,H*D] lane layout
-        # (layout-native Pallas flash, no BSHD<->BHSD transposes); "bshd"
-        # is the historical [B,S,H,D] boundary. Applied by the engine via
+        # (layout-native Pallas flash, no BSHD<->BHSD transposes);
+        # "paired" additionally packs 128/D heads per lane-full MXU tile
+        # (the d=64 full-lane path, falling back to folded/bshd where
+        # pairing does not apply); "bshd" is the historical [B,S,H,D]
+        # boundary. Applied by the engine via
         # ops.attention.set_default_attention_layout; models whose own
         # config pins attention_layout override this.
         from deepspeed_tpu.ops.attention import ATTENTION_LAYOUTS

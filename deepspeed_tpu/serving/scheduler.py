@@ -142,6 +142,11 @@ class ContinuousBatchScheduler:
                     "fast_decode=False would silently never speculate")
         self.speculative = speculative
         self.spec_stats = SpeculativeStats()
+        #: acceptance-aware K autotuning (speculative.autotune_k): per-
+        #: request accept-rate EWMA and the effective K it currently
+        #: prescribes (both dropped when the request terminalizes)
+        self._spec_accept_ewma: Dict[int, float] = {}
+        self._spec_k: Dict[int, int] = {}
         #: pure-decode ticks go through ``engine.decode_step`` — block
         #: tables/positions stay device-resident across ticks and the
         #: only host transfer is the sampled-token fetch, instead of a
@@ -503,17 +508,28 @@ class ContinuousBatchScheduler:
         where the sequential run would have stopped.
         """
         spec = self.speculative
-        gamma = spec.draft_k
         drafts: List[List[int]] = []
+        k_targets: List[int] = []
         for r in packed:
+            # acceptance-aware K: a request whose accept-rate EWMA has
+            # decayed drafts fewer tokens (down to min_draft_k), so the
+            # verify pass stops paying lookahead it never cashes;
+            # draft_k is the cap, so program shapes stay bounded
+            k_r = (self._spec_k.get(r.uid, spec.draft_k)
+                   if spec.autotune_k else spec.draft_k)
+            k_targets.append(k_r)
             # never draft past the generation budget: at most
             # remaining - 1 drafts can be emitted alongside the bonus
             remaining = r.sampling.max_new_tokens - len(r.generated)
             drafts.append(list(
-                spec.drafter.draft(r.history, min(gamma, remaining - 1))
-            )[:gamma])
+                spec.drafter.draft(r.history, min(k_r, remaining - 1))
+            )[:k_r])
         if not any(drafts):
             return None
+        # the pass's K covers the longest draft actually proposed — an
+        # all-shrunk batch runs a genuinely smaller verify program
+        gamma = max(len(d) for d in drafts) if spec.autotune_k \
+            else spec.draft_k
         K = gamma + 1
         if not self.engine.can_schedule(uids, [K] * len(uids)):
             return None                  # lookahead KV/context won't fit
@@ -559,6 +575,20 @@ class ContinuousBatchScheduler:
             off += spans[i]
             self.spec_stats.drafted += len(d)
             self.spec_stats.accepted += acc
+            self.spec_stats.k_sum += k_targets[i]
+            self.spec_stats.k_requests += 1
+            if spec.autotune_k and d:
+                a = spec.accept_ewma_alpha
+                rate = acc / len(d)
+                prev = self._spec_accept_ewma.get(req.uid)
+                ew = rate if prev is None else (1.0 - a) * prev + a * rate
+                self._spec_accept_ewma[req.uid] = ew
+                k_cur = k_targets[i]
+                if ew < spec.shrink_threshold and k_cur > spec.min_draft_k:
+                    k_cur -= 1
+                elif ew > spec.grow_threshold and k_cur < spec.draft_k:
+                    k_cur += 1
+                self._spec_k[req.uid] = k_cur
             # commit the accepted feed prefix (input + accepted drafts);
             # the engine trims rejected lookahead blocks back
             self.engine.commit_verified(req.uid, feed[i][:1 + acc])
@@ -731,7 +761,7 @@ class ContinuousBatchScheduler:
         req.finish_reason = reason
         self._close_req_span(req.uid, outcome="failed", reason=reason)
         req.transition(RequestState.FAILED)
-        self._live_uids.discard(req.uid)
+        self._drop_request_state(req.uid)
         self._finished.append(req)
         self.metrics.record_finish(req)
         logger.warning(f"serving: request {req.uid} failed: {reason}")
@@ -774,7 +804,7 @@ class ContinuousBatchScheduler:
                 self._close_req_span(req.uid, outcome="failed",
                                      reason="kv_capacity")
                 req.transition(RequestState.FAILED)
-            self._live_uids.discard(req.uid)
+            self._drop_request_state(req.uid)
             self._finished.append(req)
             self.metrics.record_finish(req)
             logger.warning(
@@ -829,13 +859,22 @@ class ContinuousBatchScheduler:
                 self._open_req_span(req, "decode")
         return emitted
 
+    def _drop_request_state(self, uid: int) -> None:
+        """Terminal-transition bookkeeping shared by finish/fail/reap/
+        handoff: the uid leaves the live set and its speculative
+        autotune state (accept-rate EWMA + effective K) is dropped so
+        the tables stay bounded by the live request set."""
+        self._live_uids.discard(uid)
+        self._spec_accept_ewma.pop(uid, None)
+        self._spec_k.pop(uid, None)
+
     def _finish(self, req: Request, reason: str) -> None:
         self.engine.flush([req.uid])
         del self._running[req.uid]
         req.finish_reason = reason
         self._close_req_span(req.uid, outcome="finished", reason=reason)
         req.transition(RequestState.FINISHED)
-        self._live_uids.discard(req.uid)
+        self._drop_request_state(req.uid)
         self._finished.append(req)
         self.metrics.record_finish(req)
 
@@ -989,7 +1028,7 @@ class ContinuousBatchScheduler:
         elif req in self._preempted:
             self._preempted.remove(req)
             self._parked_backlog -= self._work(req)
-        self._live_uids.discard(req.uid)
+        self._drop_request_state(req.uid)
         snap = req.snapshot(fed_tokens=fed)
         req.finish_reason = "handoff"
         self._close_req_span(req.uid, outcome="handoff",

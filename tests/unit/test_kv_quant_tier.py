@@ -317,7 +317,12 @@ def test_spool_restore_bit_exact_and_parity(params):
     # resume: attach restores spooled blocks bit-exactly
     cached = eng.attach_prefix(1, histA)
     assert cached == 24 and st.restored_blocks >= 2
-    assert len(st.restore_s) == st.restored_blocks   # latency recorded
+    # batched restore: ONE scatter dispatch+sync moved every contiguous
+    # tier hit — one latency sample per CALL, blocks-per-call histogram
+    # accounting for every restored block
+    assert len(st.restore_s) == 1
+    assert sum(st.restore_blocks_per_call) == st.restored_blocks
+    assert st.restore_blocks_pct(100) == float(st.restored_blocks)
     post = sm.kv_cache.gather_blocks(list(sm.get_sequence(1).blocks)[:3])
     for a, b in zip(jax.tree_util.tree_leaves(pre),
                     jax.tree_util.tree_leaves(post)):
@@ -574,3 +579,66 @@ def test_session_mix_bench_contract():
     assert treat["kv_blocks"] > base["kv_blocks"]
     for k in ("spool_p50_ms", "restore_p95_ms", "spooled_blocks"):
         assert k in treat
+
+
+# --------------------------------------------------------------------- #
+# Batched tier traffic: N blocks move with O(1) gather/scatter
+# dispatches (ROADMAP item 4e) — and stay bit-exact doing it
+# --------------------------------------------------------------------- #
+def test_batched_spool_restore_single_dispatch_and_bit_exact(params):
+    """A multi-block eviction hands the spool hook its whole victim
+    list (ONE gather_blocks dispatch + sync), and a multi-block resume
+    scatters every contiguous tier hit in ONE scatter_blocks call —
+    the per-block serial dispatch cost (~3-5 ms each) is gone.  Call
+    counts are asserted by instrumenting the cache's gather/scatter
+    entry points; bit-exactness by comparing the restored continuation
+    against a never-evicted straight-line run."""
+    rng = np.random.default_rng(33)
+    eng = _engine(params, kv_dtype="int8", host_tier=True, num_blocks=10,
+                  token_budget=64)
+    sm = eng.state_manager
+    calls = {"gather": [], "scatter": []}
+    real_gather = sm.kv_cache.gather_blocks
+    real_scatter = sm.kv_cache.scatter_blocks
+
+    def counting_gather(blocks):
+        calls["gather"].append(list(blocks))
+        return real_gather(blocks)
+
+    def counting_scatter(blocks, payload):
+        calls["scatter"].append(list(blocks))
+        return real_scatter(blocks, payload)
+
+    sm.kv_cache.gather_blocks = counting_gather
+    sm.kv_cache.scatter_blocks = counting_scatter
+
+    pA = rng.integers(0, CFG.vocab_size, size=(16,)).tolist()
+    histA = _grow_session(eng, 1, pA, 9)         # 24 seen -> 3 full blocks
+    eng.flush([1])                               # tree-held, rc1 x3
+    calls["gather"].clear()
+    # one explicit eviction of 3 blocks == exactly ONE gather dispatch
+    assert sm.prefix_cache.evict(3) == 3
+    assert len(calls["gather"]) == 1 and len(calls["gather"][0]) == 3
+    st = sm.host_tier.stats
+    assert len(sm.host_tier) == 3 and st.spooled_blocks == 3
+    assert list(st.spool_blocks_per_call) == [3]
+    assert len(st.spool_s) == 1                  # one latency sample/call
+
+    # resume: all 3 contiguous tier hits restore in ONE scatter call
+    calls["scatter"].clear()
+    cached = eng.attach_prefix(2, histA)
+    assert cached == 24 and st.restored_blocks == 3
+    assert len(calls["scatter"]) == 1 and len(calls["scatter"][0]) == 3
+    assert list(st.restore_blocks_per_call) == [3]
+    assert len(st.restore_s) == 1
+
+    # bit-exact: the batched spool->restore round trip changes nothing
+    logits = eng.put([2], [histA[cached:]])
+    ref_eng = _engine(params, kv_dtype="int8", num_blocks=33)
+    ref = ref_eng.put([2], [histA])
+    np.testing.assert_array_equal(np.asarray(logits[2]),
+                                  np.asarray(ref[2]))
+    # the blocks-per-call histogram rides the occupancy gauges
+    occ = eng.occupancy()
+    assert occ["observability/kv_spool_blocks_per_call_p50"] == 3.0
+    assert occ["observability/kv_restore_blocks_per_call_p50"] == 3.0

@@ -425,3 +425,85 @@ def test_speculative_parity_125m_f32():
         for g, r in zip(gold, reqs):
             assert r.state is RequestState.FINISHED
             assert r.generated == g.generated
+
+
+# --------------------------------------------------------------------- #
+# Acceptance-aware draft-K autotuning (ROADMAP item 1c)
+# --------------------------------------------------------------------- #
+def test_autotune_k_shrinks_on_rejection_and_stays_exact(params):
+    """An always-wrong drafter under autotune_k: each request's
+    accept-rate EWMA collapses, its effective K walks down to
+    min_draft_k (one step per verify pass), serving/spec_k_effective
+    exports below draft_k — and the emitted stream stays greedy-exact
+    throughout, because K only changes how much lookahead is verified,
+    never what is accepted."""
+    class WrongDrafter:
+        def draft(self, history, k):
+            return [(int(history[-1]) + 1 + i) % CFG.vocab_size
+                    for i in range(k)]
+
+    samp = SamplingParams(greedy=True, max_new_tokens=10)
+    s0 = _sched(params)
+    gold = [s0.submit(p, sampling=samp) for p in _prompts()]
+    s0.run_until_idle()
+    spec = SpeculativeConfig(draft_k=4, drafter=WrongDrafter(),
+                             autotune_k=True, min_draft_k=1)
+    s1 = _sched(params, spec)
+    reqs = [s1.submit(p, sampling=samp) for p in _prompts()]
+    seen_k = []
+    while s1.num_pending:
+        s1.step()
+        seen_k.extend(s1._spec_k.values())
+    for g, r in zip(gold, reqs):
+        assert r.state is RequestState.FINISHED
+        assert r.generated == g.generated
+    # rejection drove K down to the floor for every live request
+    assert seen_k and min(seen_k) == 1
+    stats = s1.spec_stats.as_dict()
+    assert 0.0 < stats["k_effective"] < 4.0
+    assert s1.telemetry()["serving/spec_k_effective"] == \
+        pytest.approx(stats["k_effective"])
+    # terminal requests drop their autotune state (tables stay bounded)
+    assert not s1._spec_k and not s1._spec_accept_ewma
+
+
+def test_autotune_k_grows_back_on_acceptance(params):
+    """A perfect drafter (feeds the gold continuation) under autotune_k
+    that STARTS shrunk: the EWMA saturates high and K walks back up to
+    the draft_k cap."""
+    samp = SamplingParams(greedy=True, max_new_tokens=12)
+    s0 = _sched(params)
+    gold = s0.submit(_prompts(1)[0], sampling=samp)
+    s0.run_until_idle()
+
+    class OracleDrafter:
+        def __init__(self, tokens):
+            self.tokens = [int(t) for t in tokens]
+
+        def draft(self, history, k):
+            # history = prompt + generated so far; continue from gold
+            done = len(history) - len(_prompts(1)[0])
+            return self.tokens[done:done + k]
+
+    spec = SpeculativeConfig(draft_k=4, autotune_k=True, min_draft_k=1,
+                             drafter=OracleDrafter(gold.generated))
+    s1 = _sched(params, spec)
+    req = s1.submit(_prompts(1)[0], sampling=samp)
+    # seed the request shrunk, as if a bad phase had just ended
+    s1._spec_k[req.uid] = 1
+    max_k = 0
+    while s1.num_pending:
+        s1.step()
+        max_k = max(max_k, s1._spec_k.get(req.uid, 0))
+    assert req.generated == gold.generated
+    assert max_k >= 3            # grew from 1 toward the cap
+    assert s1.spec_stats.accept_rate > 0.9
+
+
+def test_autotune_k_config_validation():
+    with pytest.raises(ValueError, match="min_draft_k"):
+        SpeculativeConfig(draft_k=3, min_draft_k=4)
+    with pytest.raises(ValueError, match="ewma"):
+        SpeculativeConfig(accept_ewma_alpha=0.0)
+    with pytest.raises(ValueError, match="threshold"):
+        SpeculativeConfig(shrink_threshold=0.8, grow_threshold=0.5)

@@ -137,6 +137,52 @@ def run_selftest(tol: float = 3e-2) -> dict:
                 lambda n=name, i=idx, a=(h, hkv, d, win): folded_case(
                     n, i, *a))
 
+    # ---- head-PAIRED flash (lane-full [block,128] tiles at d<128):
+    # the honest 12x64 MHA geometry the pairing exists for, GQA pairs
+    # sharing one KV load, the d=32 quad-pack, and SWA ---- #
+    from deepspeed_tpu.ops.flash_attention import flash_attention_paired
+
+    def paired_case(name, idx, h, hkv, d, win):
+        ks = jax.random.split(jax.random.fold_in(key, 300 + idx), 3)
+        q = jax.random.normal(ks[0], (2, 512, h, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (2, 512, hkv, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (2, 512, hkv, d), jnp.bfloat16)
+        qf = q.reshape(2, 512, h * d)
+        kf = k.reshape(2, 512, hkv * d)
+        vf = v.reshape(2, 512, hkv * d)
+
+        def paired(a, b, c):
+            return flash_attention_paired(
+                a, b, c, num_heads=h, num_kv_heads=hkv, causal=True,
+                window=win, interpret=False)
+
+        got = paired(qf, kf, vf).reshape(2, 512, h, d)
+        want = _xla_attention(q, k, v, causal=True, mask=None, scale=None,
+                              window=win)
+        record(name, got, want)
+
+        gk = jax.grad(lambda a, b, c: jnp.sum(
+            paired(a, b, c).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))(qf, kf, vf)
+        gr = jax.grad(lambda a, b, c: jnp.sum(
+            _xla_attention(a, b, c, causal=True, mask=None, scale=None,
+                           window=win).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32).reshape(
+            b_.shape) - b_.astype(jnp.float32))))
+            for a, b_ in zip(gk, gr))
+        results[name + "_grad"] = {"max_err": round(err, 6),
+                                   "ok": bool(err < 10 * tol)}
+
+    for idx, (name, (h, hkv, d, win)) in enumerate({
+            "paired_mha_d64": (12, 12, 64, None),
+            "paired_gqa_d64": (8, 4, 64, None),
+            "paired_quad_d32": (4, 4, 32, None),
+            "paired_swa": (4, 4, 64, 256)}.items()):
+        guarded(name,
+                lambda n=name, i=idx, a=(h, hkv, d, win): paired_case(
+                    n, i, *a))
+
     # ---- paged decode + tiled prefill kernels ---- #
     from deepspeed_tpu.inference.v2.kernels import (
         paged_attention, paged_prefill_attention)

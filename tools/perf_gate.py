@@ -56,6 +56,13 @@ KNOWN_RECORD_SPECS: Dict[str, List[Tuple[str, str]]] = {
     # silently shrinks either regresses the million-session thesis
     "serving_session_mix_resident_sessions": [
         ("value", "higher"), ("vs_baseline", "higher")],
+    # paired-vs-folded attention microbench (bench.py --paired-ab):
+    # the paired arm's step time AND its ratio against the interleaved
+    # folded arm both gate lower — a kernel change that slows the
+    # paired path or erodes its win over folded trips here, with the
+    # margin widened by the record's own interleaved-arm noise_pct
+    "train_paired_attention_ab": [
+        ("value", "lower"), ("extra.ratio_vs_folded", "lower")],
 }
 
 

@@ -107,7 +107,8 @@ def build_train_waterfall(record: dict) -> Waterfall:
         hidden=hidden, layers=int(geo["layers"]), heads=heads,
         intermediate=int(geo["intermediate"]), vocab=int(geo["vocab"]),
         batch=batch, seq=int(extra.get("seq", 1024)),
-        dtype=geo.get("dtype", "bfloat16"), n_params=n_params)
+        dtype=geo.get("dtype", "bfloat16"), n_params=n_params,
+        attention_layout=str(extra.get("attention_layout", "bshd")))
     return build_waterfall(ops, measured_s=step_ms / 1e3, peak_flops=peak,
                            hbm_bw=bw, chip=chip)
 
