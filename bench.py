@@ -224,6 +224,85 @@ def measure_paired_ab(heads: int = 12, micro_batch: int = 8,
     }
 
 
+def measure_offload_pipelined_ab(buffer_count: int = 8,
+                                 windows: int = 6,
+                                 iters_per_window: int = 4,
+                                 fp16: bool = False) -> dict:
+    """Pipelined-vs-synchronous optimizer-offload A/B, interleaved per
+    the perf_gate methodology (S P S P ... windows, median-of-window
+    step times, cross-window ratio spread as ``noise_pct``).
+
+    Runs on the single-device :class:`MiniOffloadEngine` twin — the
+    engine's OWN ``_pipelined_offload_step``/``_offload_transfer``
+    methods over a one-device mesh — so the A/B is measurable on any
+    host.  On TPU the host tier is real ``pinned_host`` memory; on a
+    CPU host launched via ``--offload-ab`` a second virtual CPU device
+    stands in (real inter-device copies); otherwise transfers degrade
+    to same-device no-ops and only the program-split cost is measured
+    (the record says which via ``host_tier``)."""
+    import math
+
+    from deepspeed_tpu.runtime.zero.offload_twin import MiniOffloadEngine
+
+    arms = {"sync": MiniOffloadEngine(pipeline=False, fp16=fp16, seed=0),
+            "pipelined": MiniOffloadEngine(pipeline=True,
+                                           buffer_count=buffer_count,
+                                           fp16=fp16, seed=0)}
+    for eng in arms.values():
+        for _ in range(3):          # warm + compile both arms up front
+            eng.step()
+        eng.sync()
+    times = {a: [] for a in arms}
+    for _ in range(windows):
+        for a, eng in arms.items():
+            t0 = time.perf_counter()
+            for _ in range(iters_per_window):
+                eng.step()
+            eng.sync()
+            times[a].append((time.perf_counter() - t0) / iters_per_window)
+    med = {a: float(np.median(times[a])) for a in arms}
+    ratios = [p / s for p, s in zip(times["pipelined"], times["sync"])]
+    ratio = float(np.median(ratios))
+    noise_pct = 100.0 * (max(ratios) - min(ratios)) / 2.0 \
+        if len(ratios) > 1 else 0.0
+    if not all(math.isfinite(med[a]) and med[a] > 0 for a in arms):
+        raise RuntimeError(f"offload A/B produced degenerate timings {med}")
+    stats = arms["pipelined"]._offload_stats.snapshot()
+    return {
+        "n_params": arms["sync"].n_params,
+        "buffer_count": buffer_count,
+        "host_tier": arms["pipelined"].host_tier,
+        "fp16": bool(fp16),
+        "interleaved_windows": windows,
+        "iters_per_window": iters_per_window,
+        "sync": {"step_time_ms": round(1000 * med["sync"], 3)},
+        "pipelined": {"step_time_ms": round(1000 * med["pipelined"], 3)},
+        # < 1.0 = pipelined beat the synchronous whole-tree boundary
+        "ratio_vs_sync": round(ratio, 4),
+        "noise_pct": round(noise_pct, 2),
+        "overlap_fraction": round(
+            stats["observability/offload_overlap_fraction"], 4),
+        "transfer_buckets": stats["observability/offload_buckets"],
+    }
+
+
+def _offload_ab_subprocess(timeout_s: float) -> dict:
+    """Run ``bench.py --offload-ab`` in a fresh interpreter and return
+    its record's ``extra``.  A subprocess because the CPU twin needs
+    ``--xla_force_host_platform_device_count=2`` in XLA_FLAGS *before*
+    jax first imports — too late for an already-initialised bench."""
+    import os
+    import subprocess
+
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--offload-ab"],
+        timeout=timeout_s, capture_output=True, text=True)
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    if "error" in rec:
+        return {"error": rec["error"]}
+    return rec["extra"]
+
+
 def _enable_compile_cache():
     """Persistent compilation cache: the 7B serving program + the two
     training geometries are ~6 min of cold compiles over the remote
@@ -409,6 +488,21 @@ def main():
         else:
             paired_ab = {"note": "skipped: bench time budget"}
 
+    # Pipelined-vs-sync optimizer-offload A/B (runs on every platform —
+    # the twin emulates the host tier; subprocess so the CPU 2-device
+    # emulation can set XLA_FLAGS before jax imports there)
+    offload_ab = None
+    if elapsed() < 520:
+        try:
+            with _stage("bench/offload_ab"):
+                offload_ab = _offload_ab_subprocess(
+                    timeout_s=max(60.0, 560 - elapsed()))
+        except Exception as e:  # noqa: BLE001
+            offload_ab = {"error": f"{type(e).__name__}: {e}"}
+        print(f"# offload A/B done at {elapsed():.0f}s", file=sys.stderr)
+    else:
+        offload_ab = {"note": "skipped: bench time budget"}
+
     # --- HLO memory ledger: the 7B ZeRO-3 VIRTUAL-MESH compile evidence
     # (ROADMAP item 3) — abstract lowering in a CPU subprocess (no
     # weights materialised, the parent's TPU backend untouched), bounded
@@ -454,6 +548,10 @@ def main():
             "head_dim": 768 // HEADLINE_HEADS,
             "micro_batch": HEADLINE_MB,
             "attention_layout": headline_layout,
+            # ZeRO comm-row inputs for perf_report's waterfall (the
+            # bench config above: stage 1, engine overlap default on)
+            "zero_stage": 1,
+            "overlap_comm": True,
             # geometry constants so perf_report's cost model needs no
             # out-of-band knowledge of the bench config
             "geometry": {"hidden": 768, "layers": 12,
@@ -463,6 +561,7 @@ def main():
                               "entries": mem_entries},
             **({"folded_attention": folded_geom} if folded_geom else {}),
             **({"paired_attention": paired_ab} if paired_ab else {}),
+            **({"offload_pipeline": offload_ab} if offload_ab else {}),
             **({"tpu_geometry": tpu_geom} if tpu_geom else {}),
             "serving_7b": serving_7b,
             "kernel_selftest": selftest,
@@ -474,6 +573,41 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--offload-ab" in sys.argv:
+        # standalone pipelined-vs-sync offload microbench: one JSON
+        # record in the perf_gate shape (tools/perf_gate.py
+        # train_offload_pipelined_ab spec gates value + ratio_vs_sync,
+        # margin widened by the record's own noise_pct).  The CPU host
+        # tier needs a second virtual device, and XLA reads the flag at
+        # first jax import — so set it before anything imports jax.
+        import os
+
+        if "--xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=2")
+        try:
+            _enable_compile_cache()
+            ab = measure_offload_pipelined_ab(
+                fp16="--fp16" in sys.argv)
+            print(json.dumps({
+                "metric": "train_offload_pipelined_ab",
+                "value": ab["pipelined"]["step_time_ms"],
+                "unit": "ms/step",
+                "vs_baseline": ab["ratio_vs_sync"],
+                "extra": ab,
+            }))
+            sys.exit(0)
+        except Exception as e:  # noqa: BLE001 — always emit a record
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({"metric": "train_offload_pipelined_ab",
+                              "value": 0, "unit": "ms/step",
+                              "vs_baseline": 0,
+                              "error": f"{type(e).__name__}: {e}"}))
+            sys.exit(0)
     if "--paired-ab" in sys.argv:
         # standalone paired-vs-folded train microbench: one JSON record
         # in the perf_gate shape (tools/perf_gate.py

@@ -25,6 +25,14 @@ Rules (ids are stable; hints name the fix):
   traced value is the same sync but type-invisible to AST — the
   runtime :class:`~deepspeed_tpu.analysis.trace_guard.TraceGuard`
   (transfer guard) owns that form.
+* ``sync-in-transfer-loop`` — ``jax.device_get``/``block_until_ready``/
+  ``.item()`` inside a ``for``/``while`` loop of a transfer-shaped
+  function (name mentions offload/transfer/place/spool/swap/restore/
+  spill/prefetch): blocking per leaf/bucket serializes the host against
+  every copy and kills the stream overlap the loop exists to create
+  (the serial-dispatch bug class the batched KV spool fix killed; the
+  pipelined offload step keeps its only blocking form behind the
+  opt-in ``OffloadTransferStats.timed_wait`` profile method).
 * ``timing-no-block`` — a wall-clock duration bracket (``t1 - t0``
   with both ends from ``time.time``/``time.perf_counter``) that is
   non-monotonic (``time.time``) and/or never blocks on device results
@@ -47,6 +55,11 @@ from deepspeed_tpu.analysis.common import Finding, relpath
 
 #: function names treated as hot "step" paths for step-host-sync
 STEP_NAMES = {"step", "train_batch", "tick", "_post_step_bookkeeping"}
+
+#: substrings that mark a function as a transfer/placement loop for
+#: sync-in-transfer-loop (host<->device streaming paths)
+TRANSFER_FN_MARKERS = ("offload", "transfer", "place", "spool", "swap",
+                       "restore", "spill", "prefetch")
 
 _WALLCLOCK_ATTRS = {("time", "time"), ("time", "perf_counter"),
                     ("time", "monotonic"), ("time", "process_time"),
@@ -177,6 +190,8 @@ class _RuleVisitor(ast.NodeVisitor):
         self._func_stack.append((node.name, jit_ctx))
         if node.name in STEP_NAMES or node.name.endswith("_step"):
             self._check_step_sync(node)
+        if any(m in node.name.lower() for m in TRANSFER_FN_MARKERS):
+            self._check_transfer_loop_sync(node)
         self._check_timing_bracket(node)
         self.generic_visit(node)
         self._func_stack.pop()
@@ -279,6 +294,53 @@ class _RuleVisitor(ast.NodeVisitor):
                     "accumulate the flag on device and fetch at "
                     "reporting boundaries only (see runtime/engine.py "
                     "overflow accounting / _log_fp16_skips)")
+
+    def _check_transfer_loop_sync(self, node: ast.FunctionDef):
+        """Blocking calls inside the per-leaf/per-bucket loops of a
+        transfer-shaped function: each iteration then waits for its copy
+        before dispatching the next, so the loop degrades to one serial
+        round-trip per leaf — exactly the dispatch pattern the batched
+        spool/offload paths exist to avoid.  A deliberate profiling wait
+        belongs in a named helper (``OffloadTransferStats.timed_wait``)
+        so the hot loop never inlines the blocking form."""
+        seen: Set[int] = set()   # a call in a nested loop is one finding
+        for loop in _walk_own_scope(node):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            # pruned walk: a helper DEFINED inside the loop runs when
+            # called, not per iteration — its body is that function's
+            # own problem (visit_FunctionDef sees it separately)
+            stack = list(ast.iter_child_nodes(loop))
+            while stack:
+                sub = stack.pop()
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    continue
+                stack.extend(ast.iter_child_nodes(sub))
+                if not isinstance(sub, ast.Call) or id(sub) in seen:
+                    continue
+                seen.add(id(sub))
+                target = _call_target(sub) or ""
+                blocking = None
+                if target.endswith("device_get"):
+                    blocking = f"{target}(...)"
+                elif target.endswith("block_until_ready"):
+                    blocking = f"{target}(...)"
+                elif isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "item" and not sub.args:
+                    blocking = ".item()"
+                if blocking:
+                    self._emit(
+                        "sync-in-transfer-loop", sub,
+                        f"{blocking} inside a loop of transfer function "
+                        f"'{node.name}' blocks the host once per "
+                        "iteration — the copies serialize instead of "
+                        "streaming",
+                        "dispatch the whole bucket (batched "
+                        "jax.device_put) and block once outside the "
+                        "loop, or move profiling waits behind an "
+                        "opt-in helper (OffloadTransferStats."
+                        "timed_wait)")
 
     def _check_timing_bracket(self, node: ast.FunctionDef):
         timed_locals: Dict[str, str] = {}   # local name -> clock

@@ -60,6 +60,31 @@ def _declare(reg: MetricsRegistry) -> None:
     # per-tenant token occupancy over live requests
     reg.gauge("observability/tenant_tokens_*", unit="tokens",
               help="live token occupancy per tenant")
+    # optimizer-offload transfer streams (runtime/zero/offload.py
+    # OffloadTransferStats.snapshot(), exported through the engine's
+    # register_observability provider) — the pipelined host-Adam path's
+    # spill/restore accounting and its structural overlap evidence
+    reg.counter("observability/offload_spilled_bytes", unit="bytes",
+                help="master/opt bytes streamed device -> host tier")
+    reg.counter("observability/offload_restored_bytes", unit="bytes",
+                help="master/opt bytes streamed host tier -> device")
+    reg.counter("observability/offload_transfers",
+                help="bucket transfer dispatches (spills + restores)")
+    reg.counter("observability/offload_pipeline_steps",
+                help="optimizer steps taken through the pipelined "
+                     "per-bucket offload path")
+    reg.gauge("observability/offload_buckets",
+              help="transfer buckets per pipelined step (byte-balanced "
+                   "over offloaded leaves)")
+    reg.gauge("observability/offload_overlap_fraction",
+              help="fraction of bucket transfers dispatched while "
+                   "another bucket's update was still in flight")
+    reg.gauge("observability/offload_bucket_transfer_p50_s", unit="s",
+              help="bucket transfer latency p50 (profile_transfers "
+                   "mode only — blocked, not dispatch)")
+    reg.gauge("observability/offload_bucket_transfer_p95_s", unit="s",
+              help="bucket transfer latency p95 (profile_transfers "
+                   "mode only)")
 
 
 _declare(MetricsRegistry.default())

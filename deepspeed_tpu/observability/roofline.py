@@ -55,6 +55,32 @@ CHIP_SPECS = (
 CPU_PEAK_FLOPS = 2e12
 CPU_HBM_BW = 100e9
 
+#: device-kind substring -> nominal per-chip aggregate ICI bandwidth
+#: (one-way, bytes/s) — the ceiling the comm rows are priced against.
+#: Aggregates, not per-link: the collectives below use every link.
+ICI_BW = (
+    ("v5 lite", 200e9),
+    ("v5e", 200e9),
+    ("v5p", 600e9),
+    ("v5", 600e9),
+    ("v4", 300e9),
+    ("v6", 448e9),
+    ("trillium", 448e9),
+)
+CPU_ICI_BW = 10e9   # nominal loopback figure for CPU plumbing runs
+
+
+def interconnect_bw(device_kind: str = "", platform: str = "") -> float:
+    """Nominal ICI bytes/s for a device-kind string (same matching rules
+    as :func:`chip_specs`; conservative v5e default for unknown TPUs)."""
+    kind = (device_kind or "").lower()
+    if platform == "cpu" or kind.startswith("cpu"):
+        return CPU_ICI_BW
+    for sub, bw in ICI_BW:
+        if sub in kind:
+            return bw
+    return 200e9
+
 
 def chip_specs(device_kind: str = "", platform: str = ""):
     """(peak_flops, hbm_bytes_per_s, label) for a device kind string (as
@@ -89,6 +115,10 @@ class OpCost:
     #: attainable compute ceiling is 0.5 * peak (the ROADMAP item 2
     #: head-pairing thesis, made visible per op)
     peak_scale: float = 1.0
+    #: bytes/s ceiling for this op's byte stream when it is NOT HBM —
+    #: comm rows (reduce-scatter/all-gather over ICI) set this to the
+    #: interconnect bandwidth and are reported ``bound="comm"``
+    bandwidth: Optional[float] = None
 
     @property
     def intensity(self) -> float:
@@ -186,6 +216,9 @@ def train_step_costs(hidden: int, layers: int, heads: int,
                      dtype: str = "bfloat16", n_params: Optional[int] = None,
                      optimizer_state_bytes_per_param: int = 16,
                      attention_layout: str = "bshd",
+                     dp_degree: int = 1, zero_stage: int = 1,
+                     overlap_comm: bool = False,
+                     ici_bw: Optional[float] = None,
                      phase: str = "train") -> List[OpCost]:
     """Per-op costs of ONE fwd+bwd+optimizer training step (the bench.py
     headline).  Matmul FLOPs carry the standard 3x fwd factor (1x
@@ -193,7 +226,15 @@ def train_step_costs(hidden: int, layers: int, heads: int,
     GEMM: weight stream (fwd + grad + wgrad passes ~ 3x) plus the
     activation tensors that round-trip HBM at [B, S, ...] size.  The
     optimizer row models the Adam state stream (master + m + v read and
-    written, grads read)."""
+    written, grads read).
+
+    With ``dp_degree > 1`` the ZeRO collectives appear as named comm
+    rows priced against ``ici_bw`` (``interconnect_bw`` default): the
+    gradient reduce-scatter, and for ``zero_stage >= 3`` the parameter
+    all-gather.  The row NAME carries whether the engine built the step
+    with comm bucketing/overlap (``[overlapped]``) or as a trailing
+    barrier (``[exposed]``) — the overlap claim is then a measurable
+    row in the waterfall, not an assertion."""
     head_dim = hidden // heads
     #: a d<128 attention GEMM underfills the 128-wide MXU lanes — its
     #: compute ceiling is proportionally lower (d64 ⇒ 0.5 peak).  THIS
@@ -252,6 +293,19 @@ def train_step_costs(hidden: int, layers: int, heads: int,
             bytes=float(n_params) * (optimizer_state_bytes_per_param * 2
                                      - optimizer_state_bytes_per_param // 2),
             phase=phase))
+    if dp_degree > 1 and n_params:
+        bw = ici_bw if ici_bw is not None else interconnect_bw()
+        mode = "overlapped" if overlap_comm else "exposed"
+        # ring reduce-scatter moves (dp-1)/dp of the gradient bytes
+        # through each chip's ICI links (same for the all-gather)
+        wire = float(n_params) * wb * (dp_degree - 1) / dp_degree
+        ops.append(OpCost(
+            f"comm/grad_reduce_scatter[{mode}]",
+            flops=0.0, bytes=wire, phase=phase, bandwidth=bw))
+        if zero_stage >= 3:
+            ops.append(OpCost(
+                f"comm/param_all_gather[{mode}]",
+                flops=0.0, bytes=wire, phase=phase, bandwidth=bw))
     return ops
 
 
@@ -445,7 +499,8 @@ def build_waterfall(ops: Iterable[OpCost], measured_s: float,
                     efficiency=0.0, mfu=0.0))
             continue
         att = [attainable_seconds(o.flops, o.bytes,
-                                  peak_flops * o.peak_scale, hbm_bw)
+                                  peak_flops * o.peak_scale,
+                                  o.bandwidth or hbm_bw)
                for o in phase_ops]
         att_sum = sum(att)
         for o, a in zip(phase_ops, att):
@@ -454,8 +509,9 @@ def build_waterfall(ops: Iterable[OpCost], measured_s: float,
             rows.append(WaterfallRow(
                 name=o.name, phase=phase, flops=o.flops, bytes=o.bytes,
                 attainable_s=a, achieved_s=achieved,
-                bound=roofline_bound(o.flops, o.bytes,
-                                     peak_flops * o.peak_scale, hbm_bw),
+                bound=("comm" if o.bandwidth is not None else
+                       roofline_bound(o.flops, o.bytes,
+                                      peak_flops * o.peak_scale, hbm_bw)),
                 share=achieved / measured_s,
                 efficiency=(a / achieved) if achieved > 0 else 0.0,
                 mfu=(o.flops / (achieved * peak_flops)

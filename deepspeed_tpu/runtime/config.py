@@ -83,12 +83,29 @@ class OffloadParamConfig(DeepSpeedConfigModel):
 class OffloadOptimizerConfig(DeepSpeedConfigModel):
     device: str = "none"  # none | cpu | nvme
     nvme_path: Optional[str] = None
+    #: pipelined offload: number of per-bucket streams the optimizer
+    #: update is split into (the reference's aio buffer_count — here the
+    #: in-flight H2D/update/D2H slots of the pipelined host-Adam path)
     buffer_count: int = 4
     pin_memory: bool = False
+    #: pipeline / pipeline_read / pipeline_write (reference cpu-adam
+    #: pipelining knobs): any of them enables the per-bucket pipelined
+    #: step — bucket k's update runs while bucket k+1's master/opt
+    #: stream H2D and bucket k-1's results stream back to pinned_host
+    pipeline: bool = False
     pipeline_read: bool = False
     pipeline_write: bool = False
+    #: opt-in diagnostics: block per bucket transfer and record its
+    #: latency (adds host syncs — off on the hot path, used by the
+    #: offload A/B bench to report p50/p95 transfer latency)
+    profile_transfers: bool = False
     fast_init: bool = False
     ratio: float = 1.0  # ZeRO-Offload++ twin-flow partial offload
+
+    @property
+    def pipeline_enabled(self) -> bool:
+        return bool(self.pipeline or self.pipeline_read
+                    or self.pipeline_write)
 
 
 @dataclasses.dataclass
@@ -101,9 +118,14 @@ class ZeroConfig(DeepSpeedConfigModel):
       1: optimizer state (incl. fp32 master) sharded;
       2: + gradients reduce-scattered and kept sharded;
       3: + parameters sharded (gathered on use by XLA).
-    Prefetch/overlap knobs (overlap_comm, prefetch_bucket_size, ...) are
-    accepted for config parity: XLA's latency-hiding scheduler performs the
-    equivalent gather-prefetch automatically.
+    ``overlap_comm`` (default on) buckets the fused train step's gradient
+    reduce-scatter / stage-3 param all-gather into ``reduce_bucket_size``/
+    ``allgather_bucket_size``-byte chunks chained with optimization
+    barriers, so XLA's latency-hiding scheduler interleaves per-bucket
+    collectives with backward compute instead of one combined collective
+    at the program tail (engine._comm_bucket_chain). The remaining
+    prefetch knobs (prefetch_bucket_size, ...) are accepted for config
+    parity: XLA's gather-prefetch performs the equivalent automatically.
     """
 
     stage: int = 0

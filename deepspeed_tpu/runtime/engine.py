@@ -229,6 +229,17 @@ class DeepSpeedEngine:
             param_axes=param_axes, master_axes=master_axes,
             grad_axes=grad_axes)
 
+        # async collective overlap (reference stage_1_and_2.py
+        # overlap_comm / reduce_bucket_size): chunk the grad tree into
+        # bucket-size-byte groups chained by optimization barriers so the
+        # collective combiner emits one reduce-scatter per bucket and the
+        # latency-hiding scheduler interleaves them with backward compute
+        # (default ON, the reference's default for stage >= 1)
+        self._overlap_comm = (True if zc0.overlap_comm is None
+                              else bool(zc0.overlap_comm))
+        self._reduce_bucket_bytes = int(zc0.reduce_bucket_size)
+        self._allgather_bucket_bytes = int(zc0.allgather_bucket_size)
+
         # offload (reference zero/parameter_offload.py; OffloadPP ratio) ----
         from deepspeed_tpu.runtime.zero.offload import validate_offload_config
 
@@ -238,6 +249,35 @@ class DeepSpeedEngine:
         self._offload_ratio = (zc.offload_optimizer.ratio
                                if self._offload_device else 0.0)
         self._offload_plan = None  # built with the shardings
+        # pipelined host-Adam: split the offload boundary into per-bucket
+        # H2D -> update -> D2H streams (buffer_count in-flight slots)
+        oc = zc.offload_optimizer
+        self._offload_pipeline = bool(
+            self._offload_device and oc.pipeline_enabled)
+        if self._offload_pipeline and self._offload_device != "cpu":
+            raise ValueError(
+                "offload_optimizer.pipeline applies to device='cpu' "
+                "(the NVMe tier has its own pipelined AIO path — "
+                "swap_tensor.PartitionedOptimizerSwapper)")
+        if self._offload_pipeline and self.config.flops_profiler.enabled:
+            # the profiler AOT-compiles the whole-tree apply program;
+            # per-bucket programs have no single executable to profile
+            log_dist("offload pipeline: disabled under flops_profiler "
+                     "(whole-tree apply is what the profiler costs)",
+                     ranks=[0])
+            self._offload_pipeline = False
+        self._offload_buckets = int(oc.buffer_count) if oc else 4
+        self._offload_profile = bool(oc and oc.profile_transfers)
+        self._offload_stats = None
+        if self._offload_device:
+            from deepspeed_tpu.runtime.zero.offload import (
+                OffloadTransferStats)
+
+            self._offload_stats = OffloadTransferStats()
+        # pipelined-apply program cache (built at first pipelined step)
+        self._jit_gnorm = None
+        self._jit_bucket_updates = None
+        self._pipe_layout = None
         # offload_param (the other half of ZeRO-Infinity, reference
         # zero/partition_parameters.py NVMe path): compute-precision params
         # are HOST-resident between steps; each forward stages them to HBM
@@ -581,6 +621,12 @@ class DeepSpeedEngine:
         gas = self._grad_accum_divisor()
 
         def micro_grads(params, scale, rng, args):
+            if self.zero_stage >= 3:
+                # order the stage-3 param all-gathers into
+                # allgather_bucket_size groups (overlap_comm)
+                params = self._comm_bucket_chain(
+                    params, self._allgather_bucket_bytes)
+
             def scaled_loss_fn(p):
                 out = self._apply_fn(p, *args, rng=rng, train=True)
                 loss, _aux = self._loss_from_outputs(out, args)
@@ -588,6 +634,12 @@ class DeepSpeedEngine:
 
             (_, loss), grads = jax.value_and_grad(
                 scaled_loss_fn, has_aux=True)(params)
+            if self.zero_stage >= 1:
+                # per-bucket gradient reduce-scatter (overlap_comm): the
+                # barrier chain keeps XLA from combining every leaf's
+                # collective into one program-tail reduce
+                grads = self._comm_bucket_chain(
+                    grads, self._reduce_bucket_bytes)
             return grads, loss
 
         return micro_grads
@@ -638,13 +690,77 @@ class DeepSpeedEngine:
             donate_argnums=(1,),
             out_shardings=(sh["acc_grads"], NamedSharding(self.mesh, P())))
 
+    def _loss_scale_next(self, scale, good, hyst, overflow):
+        """Dynamic loss scale bookkeeping (reference fp16/loss_scaler.py
+        DynamicLossScaler: only lower the scale once `hysteresis`
+        consecutive overflows have drained the counter).  Pure traced
+        arithmetic — shared by the whole-tree apply program and the
+        pipelined step's scalar-tail program so the two paths cannot
+        drift."""
+        if not (self.fp16_enabled and self.dynamic_loss_scale):
+            return scale, good, hyst
+        cfg = self.config.fp16
+        window = cfg.loss_scale_window
+        lower = overflow & (hyst <= 1)
+        grow = ~overflow & (good + 1 >= window)
+        new_scale = jnp.where(
+            lower, jnp.maximum(scale / 2.0, cfg.min_loss_scale),
+            jnp.where(grow, scale * 2.0, scale))
+        new_good = jnp.where(overflow | grow, 0, good + 1)
+        full = jnp.asarray(cfg.hysteresis, jnp.int32)
+        if cfg.consecutive_hysteresis:
+            # refill on every non-overflow step
+            new_hyst = jnp.where(overflow, jnp.maximum(hyst - 1, 1), full)
+        else:
+            # refill only when the scale window elapses cleanly
+            new_hyst = jnp.where(overflow, jnp.maximum(hyst - 1, 1),
+                                 jnp.where(grow, full, hyst))
+        return new_scale, new_good, new_hyst
+
+    def _comm_bucket_chain(self, tree, bucket_bytes: int):
+        """Collective-overlap bucketing (reference stage_1_and_2.py
+        ``overlap_comm``): split ``tree``'s leaves into bucket-size-byte
+        groups and chain the groups with ``lax.optimization_barrier`` —
+        value-identity, but the barrier chain stops XLA's collective
+        combiner from merging every leaf's reduce-scatter/all-gather into
+        ONE tail collective, so the latency-hiding scheduler can overlap
+        bucket k's collective with the compute still producing bucket
+        k+1.  No-op when overlap is off or the mesh has one device."""
+        if not self._overlap_comm or self.dp_world_size <= 1:
+            return tree
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if len(leaves) <= 1:
+            return tree
+        from deepspeed_tpu.runtime.zero.offload import (
+            partition_transfer_buckets)
+
+        sizes = [int(np.prod(l.shape)) * jnp.dtype(l.dtype).itemsize
+                 for l in leaves]
+        n = max(1, min(len(leaves),
+                       -(-sum(sizes) // max(int(bucket_bytes), 1))))
+        buckets = partition_transfer_buckets(sizes, n)
+        if len(buckets) <= 1:
+            return tree
+        out = list(leaves)
+        carry = None
+        for bucket in buckets:
+            vals = tuple(out[i] for i in bucket)
+            if carry is None:
+                vals = jax.lax.optimization_barrier(vals)
+            else:
+                *vals, carry = jax.lax.optimization_barrier(
+                    vals + (carry,))
+            carry = vals[0]
+            for j, i in enumerate(bucket):
+                out[i] = vals[j]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
     def _make_apply_step(self):
         """The pure optimizer-step closure, shared by the standalone apply
         program and the fused micro+apply program."""
         clip = float(self.config.gradient_clipping)
         fp16 = self.fp16_enabled
         dynamic = self.dynamic_loss_scale
-        cfg = self.config.fp16
 
         onebit = self._onebit
 
@@ -684,30 +800,9 @@ class DeepSpeedEngine:
             new_master = keep(new_master, state["master"])
             new_opt = keep(new_opt, state["opt"])
 
-            # dynamic loss scale update (reference fp16/loss_scaler.py
-            # DynamicLossScaler: only lower the scale once `hysteresis`
-            # consecutive overflows have drained the counter)
-            scale = state["loss_scale"]
-            good = state["good_steps"]
-            hyst = state["hysteresis"]
-            if fp16 and dynamic:
-                window = cfg.loss_scale_window
-                lower = overflow & (hyst <= 1)
-                grow = ~overflow & (good + 1 >= window)
-                new_scale = jnp.where(
-                    lower, jnp.maximum(scale / 2.0, cfg.min_loss_scale),
-                    jnp.where(grow, scale * 2.0, scale))
-                new_good = jnp.where(overflow | grow, 0, good + 1)
-                full = jnp.asarray(cfg.hysteresis, jnp.int32)
-                if cfg.consecutive_hysteresis:
-                    # refill on every non-overflow step
-                    new_hyst = jnp.where(overflow, jnp.maximum(hyst - 1, 1), full)
-                else:
-                    # refill only when the scale window elapses cleanly
-                    new_hyst = jnp.where(overflow, jnp.maximum(hyst - 1, 1),
-                                         jnp.where(grow, full, hyst))
-            else:
-                new_scale, new_good, new_hyst = scale, good, hyst
+            new_scale, new_good, new_hyst = self._loss_scale_next(
+                state["loss_scale"], state["good_steps"],
+                state["hysteresis"], overflow)
 
             new_state = dict(state)  # passthrough for extra keys (1-bit
             # comm errors stay zero through warmup)
@@ -737,6 +832,223 @@ class DeepSpeedEngine:
             self._make_apply_step(),
             donate_argnums=(0,),
             out_shardings=(dict(sh), scalar, scalar))
+
+    # ------------------------------------------------------------------ #
+    # Pipelined host-Adam (offload_optimizer.pipeline): the synchronous
+    # whole-tree placement boundary (OffloadPlan.place on both sides of
+    # the apply program) becomes per-bucket streams — while bucket k's
+    # updated master/opt leaves stream back to pinned_host, bucket k+1
+    # runs its update on the device, and the final spill overlaps the
+    # next step's forward (nothing below ever blocks the host).  The
+    # update math is the synchronous apply program split leaf-wise:
+    # identical per-leaf expressions fed by one shared gnorm program, so
+    # the two paths are bit-exact.
+    # ------------------------------------------------------------------ #
+    def _build_pipelined_apply(self):
+        """Compile the pipelined step's programs: one global-gnorm
+        program, one donated per-bucket update program per transfer
+        bucket (double-buffered slots: bucket k's donated inputs free
+        while bucket k+1's H2D copies arrive), and one scalar-tail
+        program for the step/scale bookkeeping.  All shapes are fixed at
+        build time — steady state retraces nothing."""
+        plan = self._offload_plan
+        sh = self._state_shardings()
+        scalar = NamedSharding(self.mesh, P())
+        fp16, dynamic = self.fp16_enabled, self.dynamic_loss_scale
+        clip = float(self.config.gradient_clipping)
+
+        def head_fn(acc_grads, loss_scale, step, opt_step, good, hyst):
+            # one dispatch for the whole scalar plane: global grad norm,
+            # overflow, and the next step/opt_step/loss-scale scalars —
+            # everything the bucket programs and the state rebuild need,
+            # computed up front so the scalars land while buckets stream
+            grads = acc_grads
+            if fp16 or dynamic:
+                inv_scale = 1.0 / loss_scale
+                grads = jax.tree.map(lambda g: g * inv_scale, grads)
+            sumsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in jax.tree.leaves(grads))
+            gnorm = jnp.sqrt(sumsq)
+            overflow = ~jnp.isfinite(gnorm) if fp16 else jnp.asarray(False)
+            opt_step_next = opt_step + 1
+            new_scale, new_good, new_hyst = self._loss_scale_next(
+                loss_scale, good, hyst, overflow)
+            return (gnorm, overflow, step + 1,
+                    jnp.where(overflow, opt_step, opt_step_next),
+                    new_scale, new_good, new_hyst)
+
+        self._jit_gnorm = jax.jit(head_fn, out_shardings=(scalar,) * 7)
+
+        def bucket_update(master, opt, acc, params, lr, opt_step,
+                          loss_scale, gnorm, overflow):
+            # master/acc/params: leaf LISTS (not tuples — the optimizer
+            # defs unpack per-leaf results with is_leaf=isinstance(
+            # tuple), so a tuple-rooted tree would read as one leaf);
+            # opt: {moment: leaf list}.  ``params`` is donation fodder
+            # only — its values are never read, but without it the cast
+            # output would be a fresh allocation every step (the
+            # synchronous apply reuses the donated state's params
+            # buffers; the bucket program must too).  The synchronous
+            # apply's per-leaf math verbatim, on a slice
+            del params
+            grads = acc
+            if fp16 or dynamic:
+                inv_scale = 1.0 / loss_scale
+                grads = jax.tree.map(lambda g: g * inv_scale, grads)
+            if clip > 0.0:
+                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * coef, grads)
+            opt_step_next = opt_step + 1
+            new_master, new_opt = self.optimizer_def.update(
+                grads, opt, master, lr, opt_step_next)
+            keep = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(overflow, o, n), new, old)
+            new_master = keep(new_master, master)
+            new_opt = keep(new_opt, opt)
+            new_params = jax.tree.map(
+                lambda m: m.astype(self.compute_dtype), new_master)
+            return (new_params, new_master, new_opt,
+                    jax.tree.map(jnp.zeros_like, acc))
+
+        # flat layout (treedef order shared by master/opt/grads/params)
+        m_sh, m_def = jax.tree_util.tree_flatten(sh["master"])
+        p_sh = jax.tree_util.tree_flatten(sh["params"])[0]
+        g_sh = jax.tree_util.tree_flatten(sh["acc_grads"])[0]
+        opt_keys = sorted(sh["opt"])
+        o_sh = {k: jax.tree_util.tree_flatten(sh["opt"][k])[0]
+                for k in opt_keys}
+        for k in opt_keys:
+            if len(o_sh[k]) != len(m_sh):
+                raise ValueError(
+                    f"offload pipeline: optimizer moment tree {k!r} is "
+                    f"not leaf-parallel to the master tree "
+                    f"({len(o_sh[k])} vs {len(m_sh)} leaves)")
+        m_host = jax.tree_util.tree_flatten(
+            plan.host_shardings(sh["master"]))[0]
+        o_host = {k: jax.tree_util.tree_flatten(
+            plan.host_shardings(sh["opt"][k]))[0] for k in opt_keys}
+        transfer, resident = plan.pipeline_buckets(self._offload_buckets)
+        buckets = [(idx, True) for idx in transfer]
+        if resident:
+            # twin-flow device-resident leaves: same update program, no
+            # transfers — scheduled first so their compute overlaps the
+            # first offloaded bucket's H2D stream
+            buckets.insert(0, (resident, False))
+        # master f32 + one f32 moment per optimizer slot
+        leaf_bytes = [4 * s * (1 + len(opt_keys))
+                      for s in plan.flat_sizes]
+        self._jit_bucket_updates = [
+            jax.jit(bucket_update, donate_argnums=(0, 1, 2, 3),
+                    out_shardings=(
+                        [p_sh[i] for i in idx],
+                        [m_sh[i] for i in idx],
+                        {k: [o_sh[k][i] for i in idx]
+                         for k in opt_keys},
+                        [g_sh[i] for i in idx]))
+            for idx, _t in buckets]
+        self._pipe_layout = {
+            "m_def": m_def, "opt_keys": opt_keys, "buckets": buckets,
+            "m_sh": m_sh, "o_sh": o_sh, "m_host": m_host,
+            "o_host": o_host, "leaf_bytes": leaf_bytes,
+        }
+
+    def _pipelined_offload_step(self, lr):
+        """One optimizer step through the per-bucket offload streams.
+        Pure async dispatch — no ``device_get``/``block_until_ready`` in
+        steady state (TraceGuard-enforced in tests); the only blocking
+        form lives behind ``offload_optimizer.profile_transfers``."""
+        if self._pipe_layout is None:
+            self._build_pipelined_apply()
+        lay, state = self._pipe_layout, self.state
+        stats = self._offload_stats
+        opt_keys = lay["opt_keys"]
+        m_flat = jax.tree_util.tree_flatten(state["master"])[0]
+        p_flat = jax.tree_util.tree_flatten(state["params"])[0]
+        g_flat = jax.tree_util.tree_flatten(state["acc_grads"])[0]
+        o_flat = {k: jax.tree_util.tree_flatten(state["opt"][k])[0]
+                  for k in opt_keys}
+        (gnorm, overflow, new_step, new_opt_step, new_scale, new_good,
+         new_hyst) = self._jit_gnorm(
+            state["acc_grads"], state["loss_scale"], state["step"],
+            state["opt_step"], state["good_steps"], state["hysteresis"])
+
+        def restore(idx, overlapped):
+            # H2D: ONE batched dispatch for the whole bucket (per-leaf
+            # device_put in a transfer loop is the serial-dispatch bug
+            # class the batched KV spool fix killed); the copies land
+            # while an earlier bucket's update computes
+            srcs = [m_flat[i] for i in idx]
+            dsts = [lay["m_sh"][i] for i in idx]
+            for k in opt_keys:
+                srcs.extend(o_flat[k][i] for i in idx)
+                dsts.extend(lay["o_sh"][k][i] for i in idx)
+            moved = jax.device_put(srcs, dsts)
+            for j, i in enumerate(idx):
+                m_flat[i] = moved[j]
+                stats.note_restore(lay["leaf_bytes"][i], overlapped)
+            for kk, k in enumerate(opt_keys):
+                base = (kk + 1) * len(idx)
+                for j, i in enumerate(idx):
+                    o_flat[k][i] = moved[base + j]
+            if self._offload_profile and moved:
+                stats.timed_wait(moved)
+
+        buckets = lay["buckets"]
+        first_transfer = next(
+            (bi for bi, (_idx, t) in enumerate(buckets) if t), None)
+        if first_transfer is not None:
+            restore(buckets[first_transfer][0], overlapped=False)
+        for bi, (idx, is_transfer) in enumerate(buckets):
+            nxt = bi + 1
+            if nxt < len(buckets) and buckets[nxt][1] \
+                    and nxt != first_transfer:
+                # prefetch bucket k+1 while bucket k's update runs
+                restore(buckets[nxt][0], overlapped=True)
+            new_p, new_m, new_o, new_g = self._jit_bucket_updates[bi](
+                [m_flat[i] for i in idx],
+                {k: [o_flat[k][i] for i in idx] for k in opt_keys},
+                [g_flat[i] for i in idx],
+                [p_flat[i] for i in idx],
+                lr, state["opt_step"], state["loss_scale"], gnorm,
+                overflow)
+            for j, i in enumerate(idx):
+                p_flat[i] = new_p[j]
+                g_flat[i] = new_g[j]
+            if is_transfer:
+                # D2H: one batched dispatch — the spill overlaps bucket
+                # k+1's update, and the last bucket's spill overlaps the
+                # NEXT step's forward (params don't depend on master/opt)
+                srcs = list(new_m)
+                dsts = [lay["m_host"][i] for i in idx]
+                for k in opt_keys:
+                    srcs.extend(new_o[k])
+                    dsts.extend(lay["o_host"][k][i] for i in idx)
+                spilled = jax.device_put(srcs, dsts)
+                for j, i in enumerate(idx):
+                    m_flat[i] = spilled[j]
+                    stats.note_spill(lay["leaf_bytes"][i],
+                                     overlapped=True)
+                for kk, k in enumerate(opt_keys):
+                    base = (kk + 1) * len(idx)
+                    for j, i in enumerate(idx):
+                        o_flat[k][i] = spilled[base + j]
+                if self._offload_profile:
+                    stats.timed_wait(spilled)
+            else:
+                for j, i in enumerate(idx):
+                    m_flat[i] = new_m[j]
+                    for k in opt_keys:
+                        o_flat[k][i] = new_o[k][j]
+        stats.note_step(sum(1 for _idx, t in buckets if t))
+        unflat = lambda flat: jax.tree_util.tree_unflatten(
+            lay["m_def"], flat)
+        self.state = dict(
+            state, step=new_step, opt_step=new_opt_step,
+            params=unflat(p_flat), master=unflat(m_flat),
+            opt={k: unflat(o_flat[k]) for k in opt_keys},
+            acc_grads=unflat(g_flat), loss_scale=new_scale,
+            good_steps=new_good, hysteresis=new_hyst)
+        return gnorm, overflow
 
     def _can_fuse_step(self) -> bool:
         """One combined micro+apply program per optimizer step — valid when
@@ -1031,6 +1343,18 @@ class DeepSpeedEngine:
             return self._finish_fused_step()
         if self._onebit_compression_stage():
             return self._onebit_step()
+        if self._offload_plan is not None and self._offload_pipeline \
+                and not self._onebit:
+            # pipelined host-Adam: per-bucket H2D/update/D2H streams in
+            # place of the synchronous whole-tree placement boundary
+            lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+            self.timers(STEP_MICRO_TIMER).start()
+            gnorm, overflow = self._pipelined_offload_step(lr)
+            self.timers(STEP_MICRO_TIMER).stop(
+                sync_obj=self.state["loss_scale"]
+                if self.config.wall_clock_breakdown else None)
+            self._post_step_bookkeeping(overflow)
+            return gnorm
         if self._jit_apply is None:
             self._build_apply()
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
@@ -1181,6 +1505,8 @@ class DeepSpeedEngine:
                 if name in self.state:
                     out[f"observability/hbm_{name}_bytes"] = \
                         tree_bytes(self.state[name])
+            if self._offload_stats is not None:
+                out.update(self._offload_stats.snapshot())
             return out
 
         registry.register_provider(key, provider)

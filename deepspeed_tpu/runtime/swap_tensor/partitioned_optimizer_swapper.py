@@ -102,17 +102,20 @@ class PartitionedOptimizerSwapper:
         mask_leaves = (jax.tree.leaves(mask) if mask is not None
                        else None)
         leaves = list(self._keys(prefix, tree))
-        selected = []
-        for i, (key, leaf) in enumerate(leaves):
-            if mask_leaves is not None and not mask_leaves[i]:
-                continue
+        selected = [i for i in range(len(leaves))
+                    if mask_leaves is None or mask_leaves[i]]
+        # ONE batched D2H fetch for every selected leaf — a per-leaf
+        # device_get inside the submit loop would block each copy before
+        # the next AIO write is even queued (sync-in-transfer-loop)
+        fetched = jax.device_get([leaves[i][1] for i in selected])
+        for i, got in zip(selected, fetched):
+            key = leaves[i][0]
             # preserve the leaf dtype: optimizer state is fp32 but the
             # ZeRO-Infinity PARAM tier swaps compute-precision (bf16)
             # leaves — numpy handles ml_dtypes.bfloat16 natively
-            arr = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+            arr = np.ascontiguousarray(np.asarray(got))
             self.swapper.swap_out(key, arr)
             self._manifest[key] = (arr.shape, arr.dtype)
-            selected.append(i)
         # barrier then hand back evictable views
         out_leaves = [leaf for _key, leaf in leaves]
         for i in selected:

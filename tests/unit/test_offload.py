@@ -262,3 +262,241 @@ def test_nvme_swapper_rss_bounded(tmp_path):
     back = sw.swap_in_tree_to_device("params", swapped, sh)
     for i in range(n_leaves):
         np.testing.assert_array_equal(np.asarray(back[f"p{i}"]), make(i))
+
+
+# ------------------------------------------------------------------ #
+# Pipelined host-Adam (per-bucket offload streams) — exercised through
+# the single-device MiniOffloadEngine twin, which runs the ENGINE'S OWN
+# unbound step methods (see runtime/zero/offload_twin.py), so these
+# results hold for the engine code itself on hosts where the full
+# multi-axis engine cannot construct.
+# ------------------------------------------------------------------ #
+from deepspeed_tpu.runtime.zero.offload import (  # noqa: E402
+    OffloadTransferStats, partition_transfer_buckets)
+from deepspeed_tpu.runtime.zero.offload_twin import MiniOffloadEngine
+
+
+def _twin_run(pipeline, fp16=False, steps=4, buffer_count=3,
+              overflow_at=None, seed=0):
+    eng = MiniOffloadEngine(pipeline=pipeline, fp16=fp16,
+                            buffer_count=buffer_count, seed=seed)
+    gnorms = []
+    for t in range(steps):
+        g = eng.synthetic_grads(t)
+        if overflow_at is not None and t == overflow_at:
+            g[0] = g[0] * np.float32(np.inf)
+        eng.set_acc_grads(g)
+        gnorms.append(float(jax.device_get(eng.step())))
+    eng.sync()
+    return eng, gnorms
+
+
+def _assert_twin_states_equal(a, b):
+    for name in ("master", "params", "acc_grads"):
+        for la, lb in zip(jax.tree.leaves(a.state[name]),
+                          jax.tree.leaves(b.state[name])):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    for k in a.state["opt"]:
+        for la, lb in zip(jax.tree.leaves(a.state["opt"][k]),
+                          jax.tree.leaves(b.state["opt"][k])):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    for s in ("step", "opt_step", "loss_scale", "good_steps",
+              "hysteresis"):
+        assert float(jax.device_get(a.state[s])) == \
+            float(jax.device_get(b.state[s])), s
+
+
+def test_pipelined_twin_bit_exact_fp32():
+    """>=3 steps through the per-bucket pipelined arm produce BIT-equal
+    master/opt/params/scalars vs the synchronous whole-tree boundary."""
+    sync, gn_s = _twin_run(pipeline=False, steps=4)
+    pipe, gn_p = _twin_run(pipeline=True, steps=4)
+    assert gn_s == gn_p
+    _assert_twin_states_equal(sync, pipe)
+    assert int(jax.device_get(pipe.state["opt_step"])) == 4
+
+
+def test_pipelined_twin_bit_exact_fp16_overflow_skip():
+    """fp16 with an inf gradient on step 1: both arms must SKIP that
+    update (opt_step stays behind step), halve the loss scale through
+    the shared _loss_scale_next bookkeeping, and stay bit-exact."""
+    sync, gn_s = _twin_run(pipeline=False, fp16=True, steps=4,
+                           overflow_at=1)
+    pipe, gn_p = _twin_run(pipeline=True, fp16=True, steps=4,
+                           overflow_at=1)
+    assert gn_s == gn_p
+    _assert_twin_states_equal(sync, pipe)
+    assert int(jax.device_get(pipe.state["step"])) == 4
+    assert int(jax.device_get(pipe.state["opt_step"])) == 3  # one skip
+    # hysteresis=2: a single overflow drains the counter but does NOT
+    # lower the scale yet (reference DynamicLossScaler semantics)
+    assert int(jax.device_get(pipe.state["hysteresis"])) == 1
+    assert float(jax.device_get(pipe.state["loss_scale"])) == 2.0 ** 8
+
+
+def test_pipelined_twin_mid_pipeline_fetch_drains():
+    """Fetching the whole state tree right after a pipelined step — the
+    checkpoint path's read — must drain every in-flight bucket stream:
+    the snapshot equals the synchronous arm's, and training continues
+    bit-exact afterwards."""
+    sync, _ = _twin_run(pipeline=False, steps=2)
+    pipe = MiniOffloadEngine(pipeline=True, buffer_count=3, seed=0)
+    for t in range(2):
+        pipe.set_acc_grads(pipe.synthetic_grads(t))
+        pipe.step()
+    # NO sync() first: device_get itself must wait out the streams
+    snap = jax.device_get(pipe.state)
+    ref = jax.device_get(sync.state)
+    for la, lb in zip(jax.tree.leaves(ref), jax.tree.leaves(snap)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    # the mid-pipeline read must not corrupt subsequent steps
+    for t in range(2, 4):
+        for e in (sync, pipe):
+            e.set_acc_grads(e.synthetic_grads(t))
+            e.step()
+    sync.sync(), pipe.sync()
+    _assert_twin_states_equal(sync, pipe)
+
+
+def test_pipelined_twin_traceguard_steady_state():
+    """Warmed pipelined steps: 0 backend compiles and 0 host syncs —
+    the per-bucket programs compile once and the hot loop never blocks
+    (profiling waits live behind the opt-in timed_wait helper)."""
+    from deepspeed_tpu.analysis.trace_guard import TraceGuard
+
+    eng = MiniOffloadEngine(pipeline=True, buffer_count=3, seed=0)
+    grads = [eng.synthetic_grads(t) for t in range(5)]
+    for t in range(3):                      # warm: compiles land here
+        eng.set_acc_grads(grads[t])
+        eng.step()
+    eng.sync()
+    with TraceGuard(max_compiles=0, max_host_syncs=0,
+                    label="pipelined offload steady state") as tg:
+        for t in range(3, 5):
+            eng.set_acc_grads(grads[t])
+            eng.step()
+    eng.sync()
+    assert tg.compiles == 0 and tg.host_syncs == 0
+
+
+def test_pipelined_twin_transfer_stats():
+    """The hot path feeds the observability gauges: every step spills
+    and restores the full offloaded byte volume, and with >1 bucket the
+    structural overlap fraction is strictly positive."""
+    eng, _ = _twin_run(pipeline=True, steps=3, buffer_count=3)
+    stats = eng._offload_stats
+    snap = stats.snapshot()
+    assert snap["observability/offload_pipeline_steps"] == 3
+    assert snap["observability/offload_restored_bytes"] == \
+        snap["observability/offload_spilled_bytes"] > 0
+    assert 0.0 < snap["observability/offload_overlap_fraction"] <= 1.0
+
+
+# ------------------------------------------------------------------ #
+# Unit coverage for the pipelining building blocks
+# ------------------------------------------------------------------ #
+def test_partition_transfer_buckets_balance_and_determinism():
+    sizes = [100, 1, 1, 50, 50, 2, 97, 3]
+    a = partition_transfer_buckets(sizes, 3)
+    b = partition_transfer_buckets(list(sizes), 3)
+    assert a == b                                   # deterministic
+    assert sorted(i for bk in a for i in bk) == list(range(len(sizes)))
+    loads = [sum(sizes[i] for i in bk) for bk in a]
+    # LPT bound: max load <= 4/3 * optimal (optimal >= total/n)
+    assert max(loads) <= (4 / 3) * (sum(sizes) / 3) + max(sizes) / 3
+    assert [bk[0] for bk in a] == sorted(bk[0] for bk in a)
+
+
+def test_partition_transfer_buckets_edges():
+    with pytest.raises(ValueError, match="num_buckets"):
+        partition_transfer_buckets([1, 2], 0)
+    assert partition_transfer_buckets([], 4) == []
+    # fewer leaves than buckets -> fewer (non-empty) buckets
+    assert partition_transfer_buckets([5, 7], 4) == [[0], [1]]
+    assert partition_transfer_buckets([5, 7, 9], 1) == [[0, 1, 2]]
+
+
+def test_offload_plan_pipeline_buckets_partial_ratio():
+    """Buckets cover exactly the offloaded leaves; twin-flow residents
+    come back separately for the in-place update path."""
+    shapes = jax.eval_shape(lambda: {
+        "big_a": jnp.zeros((1000,)), "big_b": jnp.zeros((900,)),
+        "mid": jnp.zeros((100,)), "tiny": jnp.zeros((4,))})
+    plan = OffloadPlan(shapes, ratio=0.9)
+    buckets, resident = plan.pipeline_buckets(2)
+    offloaded = sorted(i for b in buckets for i in b)
+    assert sorted(offloaded + resident) == list(range(4))
+    flat_mask = plan.flat_mask
+    assert all(flat_mask[i] for i in offloaded)
+    assert not any(flat_mask[i] for i in resident)
+    assert len(buckets) == 2 and all(buckets)
+
+
+def test_offload_pipeline_config_property():
+    from deepspeed_tpu.runtime.config import OffloadOptimizerConfig
+
+    assert not OffloadOptimizerConfig(device="cpu").pipeline_enabled
+    assert OffloadOptimizerConfig(device="cpu",
+                                  pipeline=True).pipeline_enabled
+    assert OffloadOptimizerConfig(device="cpu",
+                                  pipeline_read=True).pipeline_enabled
+    assert OffloadOptimizerConfig(device="cpu",
+                                  pipeline_write=True).pipeline_enabled
+
+
+def test_transfer_stats_structural_overlap():
+    st = OffloadTransferStats()
+    st.note_restore(100, overlapped=False)      # first bucket exposed
+    st.note_restore(100, overlapped=True)
+    st.note_spill(100, overlapped=True)
+    st.note_spill(100, overlapped=True)
+    st.note_step(buckets=2)
+    snap = st.snapshot()
+    assert snap["observability/offload_transfers"] == 4
+    assert snap["observability/offload_overlap_fraction"] == 0.75
+    assert snap["observability/offload_pipeline_steps"] == 1
+    assert snap["observability/offload_buckets"] == 2
+
+
+def test_comm_bucket_chain_value_identity():
+    """The overlap_comm barrier chain reorders scheduling, never values:
+    every leaf comes back numerically identical, in any bucket count."""
+    from types import SimpleNamespace
+
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    rng = np.random.default_rng(3)
+    tree = {f"g{i}": jnp.asarray(
+        rng.standard_normal((2 ** (i + 2),)).astype(np.float32))
+        for i in range(6)}
+    stub = SimpleNamespace(_overlap_comm=True, dp_world_size=2)
+    for bucket_bytes in (1, 64, 10 ** 9):
+        out = DeepSpeedEngine._comm_bucket_chain(stub, tree, bucket_bytes)
+        assert jax.tree_util.tree_structure(out) == \
+            jax.tree_util.tree_structure(tree)
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(out[k]),
+                                          np.asarray(tree[k]))
+    # disabled / single-device meshes are strict no-ops
+    off = SimpleNamespace(_overlap_comm=False, dp_world_size=2)
+    assert DeepSpeedEngine._comm_bucket_chain(off, tree, 64) is tree
+    one = SimpleNamespace(_overlap_comm=True, dp_world_size=1)
+    assert DeepSpeedEngine._comm_bucket_chain(one, tree, 64) is tree
+
+
+def test_engine_pipelined_offload_parity():
+    """Full-engine pipelined-vs-sync parity (needs the multi-axis mesh
+    engine; skipped on hosts where it cannot construct — the twin tests
+    above cover the same code paths single-device)."""
+    try:
+        ref = _engine(_config(offload={"device": "cpu"}))
+    except Exception as e:  # noqa: BLE001 — jax-version-gated engine
+        pytest.skip(f"full engine unavailable on this host: {e}")
+    pipe = _engine(_config(offload={"device": "cpu", "pipeline": True,
+                                    "buffer_count": 3}))
+    l_ref = train_steps(ref, steps=4, batch=16, hidden_dim=HIDDEN)
+    l_pipe = train_steps(pipe, steps=4, batch=16, hidden_dim=HIDDEN)
+    np.testing.assert_allclose(l_pipe, l_ref, rtol=0, atol=0)
+    for a, b in zip(jax.tree.leaves(jax.device_get(ref.state["master"])),
+                    jax.tree.leaves(jax.device_get(pipe.state["master"]))):
+        np.testing.assert_array_equal(a, b)

@@ -56,6 +56,10 @@ KNOWN_RECORD_SPECS: Dict[str, List[Tuple[str, str]]] = {
     # silently shrinks either regresses the million-session thesis
     "serving_session_mix_resident_sessions": [
         ("value", "higher"), ("vs_baseline", "higher")],
+    # matrix rows (tools/perf_matrix.py) for the speculative and
+    # fleet/disagg serving milestones gate their goodput headline
+    "serving_speculative_decode_tokens_per_sec": [("value", "higher")],
+    "serving_fleet_goodput_tokens_per_sec": [("value", "higher")],
     # paired-vs-folded attention microbench (bench.py --paired-ab):
     # the paired arm's step time AND its ratio against the interleaved
     # folded arm both gate lower — a kernel change that slows the
@@ -63,6 +67,13 @@ KNOWN_RECORD_SPECS: Dict[str, List[Tuple[str, str]]] = {
     # margin widened by the record's own interleaved-arm noise_pct
     "train_paired_attention_ab": [
         ("value", "lower"), ("extra.ratio_vs_folded", "lower")],
+    # pipelined-vs-sync optimizer-offload microbench (bench.py
+    # --offload-ab): the pipelined arm's step time AND its ratio
+    # against the interleaved synchronous-boundary arm both gate lower
+    # — a change that slows the bucket streams or erodes them against
+    # the whole-tree boundary trips here (noise-widened as above)
+    "train_offload_pipelined_ab": [
+        ("value", "lower"), ("extra.ratio_vs_sync", "lower")],
 }
 
 

@@ -103,12 +103,21 @@ def build_train_waterfall(record: dict) -> Waterfall:
     n_params = int(float(extra.get("params_m", 0.0)) * 1e6) or None
     peak, bw, chip = chip_specs(extra.get("device_kind", ""),
                                 extra.get("platform", ""))
+    from deepspeed_tpu.observability.roofline import interconnect_bw
+
     ops = train_step_costs(
         hidden=hidden, layers=int(geo["layers"]), heads=heads,
         intermediate=int(geo["intermediate"]), vocab=int(geo["vocab"]),
         batch=batch, seq=int(extra.get("seq", 1024)),
         dtype=geo.get("dtype", "bfloat16"), n_params=n_params,
-        attention_layout=str(extra.get("attention_layout", "bshd")))
+        attention_layout=str(extra.get("attention_layout", "bshd")),
+        # ZeRO comm rows: dp degree + stage + the engine's overlap knob
+        # come from the record, the ICI ceiling from the chip tables
+        dp_degree=int(extra.get("n_devices", 1)),
+        zero_stage=int(extra.get("zero_stage", 1)),
+        overlap_comm=bool(extra.get("overlap_comm", False)),
+        ici_bw=interconnect_bw(extra.get("device_kind", ""),
+                               extra.get("platform", "")))
     return build_waterfall(ops, measured_s=step_ms / 1e3, peak_flops=peak,
                            hbm_bw=bw, chip=chip)
 
