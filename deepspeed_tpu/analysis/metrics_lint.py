@@ -35,7 +35,8 @@ from typing import Iterable, List, Optional, Sequence
 
 from deepspeed_tpu.analysis.common import Finding, relpath
 
-NAMESPACES = ("serving/", "fleet/", "resilience/", "observability/")
+NAMESPACES = ("serving/", "fleet/", "resilience/", "observability/",
+              "gateway/")
 RULE = "metric-name"
 
 
@@ -43,6 +44,7 @@ def declared_specs():
     """The default registry's declarations, with every declaring metrics
     module imported first (import is what declares)."""
     import deepspeed_tpu.fleet.metrics  # noqa: F401 — declares fleet/*
+    import deepspeed_tpu.gateway.metrics  # noqa: F401
     import deepspeed_tpu.observability.metrics  # noqa: F401
     import deepspeed_tpu.resilience.metrics  # noqa: F401
     import deepspeed_tpu.serving.metrics  # noqa: F401

@@ -376,7 +376,8 @@ class ServingFleet:
                priority: Optional[int] = None,
                deadline_s: Optional[float] = None,
                sampling: Optional[SamplingParams] = None,
-               on_token=None) -> FleetRequest:
+               on_token=None,
+               trace_id: Optional[str] = None) -> FleetRequest:
         """Admit one request through the front door (quota / priority /
         SLO gates, cache-affine placement).  Returns the durable
         :class:`FleetRequest` handle; ``on_token(fleet_request, token)``
@@ -384,7 +385,10 @@ class ServingFleet:
         :class:`AdmissionBudget` installed, overload sheds the request
         here (:class:`OverloadShedError` with a retry-after hint),
         lowest priority class first, before the router's per-replica
-        SLO gate ever scores it."""
+        SLO gate ever scores it.  ``trace_id`` lets an upstream edge
+        (the HTTP gateway) mint the distributed-tracing id before
+        admission, so the id it returned to the client is the one every
+        span carries; omitted, the fleet mints one here."""
         cost = 0.0
         if self.admission is not None:
             sp = sampling if sampling is not None else SamplingParams()
@@ -406,7 +410,7 @@ class ServingFleet:
         fr = FleetRequest(uid=uid, prompt=[int(t) for t in prompt],
                           sampling=sampling or SamplingParams(),
                           tenant=tenant, on_token=on_token,
-                          trace_id=mint_trace_id())
+                          trace_id=trace_id or mint_trace_id())
         try:
             req = self.router.submit(
                 fr.prompt, tenant=tenant, priority_class=priority_class,

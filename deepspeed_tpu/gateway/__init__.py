@@ -1,0 +1,39 @@
+"""HTTP/SSE streaming gateway + recorded-trace load harness — the
+fleet's front door.
+
+* :class:`GatewayServer` — stdlib-asyncio HTTP/1.1 server exposing
+  ``POST /v1/generate`` with SSE token streaming over a
+  :class:`~deepspeed_tpu.fleet.fleet.ServingFleet` (or any
+  fleet-shaped backend): per-tenant bearer auth, TenantQuota /
+  AdmissionBudget verdicts as HTTP 429 + ``Retry-After``, client
+  deadlines propagated as ``deadline_s``, quarantine / replay-budget
+  failures as typed ``error`` events, and a ``X-Trace-Id`` header
+  minted at the edge so one Perfetto trace spans HTTP accept →
+  scheduler tick → emit.
+* :class:`StreamBridge` — exactly-once ``(uid, position)`` token
+  dedupe between the fleet's ``on_token`` callback and the SSE wire.
+* :func:`generate` / :class:`GatewayResponse` — the stdlib client the
+  smoke tool and tests speak through.
+* :mod:`deepspeed_tpu.gateway.loadgen` — record / reshape / replay
+  multi-tenant request traces (:class:`RequestTrace`,
+  :func:`replay`).
+"""
+
+from deepspeed_tpu.gateway.bridge import StreamBridge
+from deepspeed_tpu.gateway.client import GatewayResponse, generate
+from deepspeed_tpu.gateway.loadgen import (RequestTrace, TraceRequest,
+                                           replay, synth_trace)
+from deepspeed_tpu.gateway.metrics import GatewayMetrics
+from deepspeed_tpu.gateway.server import GatewayServer
+
+__all__ = [
+    "GatewayMetrics",
+    "GatewayResponse",
+    "GatewayServer",
+    "RequestTrace",
+    "StreamBridge",
+    "TraceRequest",
+    "generate",
+    "replay",
+    "synth_trace",
+]

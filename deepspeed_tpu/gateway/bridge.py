@@ -1,0 +1,83 @@
+"""Exactly-once SSE token bridge: fleet/scheduler ``on_token`` callbacks
+-> an ordered, gap-free, duplicate-free ``(position, token)`` stream.
+
+The fleet's journal (``FleetRequest.tokens``; ``Request.generated`` at
+the scheduler level) is the single source of truth for what has been
+delivered to a request across replica incarnations.  A kill→replay
+continues the stream by pre-seeding the replay's ``generated`` with the
+journal prefix, so in the healthy design ``on_token`` only ever fires
+for NEW positions — but the bridge must not *trust* that: a buggy
+replay path that re-fires delivered tokens, or a callback raced against
+a journal append, must not duplicate bytes on a client's wire.
+
+So the bridge is keyed by ``(uid, position)``: on every callback it
+reads the journal and emits exactly the contiguous positions it has not
+yet emitted (``journal[next_pos:]``).  A callback that presents no new
+position is counted in ``duplicates_suppressed`` and dropped; a
+callback that presents several (the bridge missed one — e.g. a burst of
+speculative-decode acceptances delivered in one tick) catches up in
+order.  Gap-free and duplicate-free hold by construction, per position,
+whatever the callback cadence was.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+
+class StreamBridge:
+    """Per-request exactly-once token buffer between the (synchronous)
+    scheduler/fleet callback and an (async) SSE writer.
+
+    Use ``bridge.on_token`` as the ``on_token=`` callback of
+    ``ServingFleet.submit`` / ``ContinuousBatchScheduler.submit``; the
+    consumer calls :meth:`drain` for the ordered new ``(pos, token)``
+    pairs.  Single-threaded by design: the fleet pump and the SSE
+    writers share one event loop (the gateway's), so no locking — a
+    thread-driven fleet must marshal callbacks onto the loop itself.
+    """
+
+    def __init__(self, uid: Optional[int] = None):
+        self.uid = uid
+        self.next_pos = 0              # first journal position not yet emitted
+        self.duplicates_suppressed = 0
+        self.emitted: List[int] = []   # every token emitted, in order
+        self._out: Deque[Tuple[int, int]] = deque()
+
+    # ------------------------------------------------------------------ #
+    # Producer side (fleet/scheduler callback)
+    # ------------------------------------------------------------------ #
+    def on_token(self, req, tok: int) -> None:
+        """``on_token(fleet_request_or_request, token)`` — reads the
+        request's own journal and enqueues only unseen positions."""
+        if self.uid is None:
+            self.uid = getattr(req, "uid", None)
+        journal = getattr(req, "tokens", None)
+        if journal is None:
+            journal = req.generated
+        if len(journal) <= self.next_pos:
+            # (uid, position) already delivered — a replayed/duplicated
+            # callback; suppress, never re-emit a position
+            self.duplicates_suppressed += 1
+            return
+        for pos in range(self.next_pos, len(journal)):
+            t = int(journal[pos])
+            self._out.append((pos, t))
+            self.emitted.append(t)
+        self.next_pos = len(journal)
+
+    # ------------------------------------------------------------------ #
+    # Consumer side (SSE writer / replayer)
+    # ------------------------------------------------------------------ #
+    @property
+    def pending(self) -> int:
+        return len(self._out)
+
+    def drain(self) -> List[Tuple[int, int]]:
+        """All queued ``(position, token)`` pairs, in order; clears the
+        queue.  Positions across successive drains are the contiguous
+        sequence 0, 1, 2, ... — that is the exactly-once contract."""
+        items = list(self._out)
+        self._out.clear()
+        return items

@@ -1,0 +1,141 @@
+"""Minimal stdlib asyncio client for the gateway's SSE endpoint.
+
+The smoke tool, the unit tests, and the load harness all speak to the
+gateway through this one parser, so the bytes-on-the-wire contract
+(status line, ``X-Trace-Id`` / ``Retry-After`` headers, ``token`` /
+``done`` / ``error`` events) is exercised by a real TCP client — not by
+calling the server's internals.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class GatewayResponse:
+    """One fully-consumed ``POST /v1/generate`` exchange."""
+
+    status: int
+    headers: Dict[str, str]
+    #: (event name, parsed JSON data) in arrival order (SSE responses)
+    events: List[Tuple[str, dict]] = dataclasses.field(default_factory=list)
+    #: non-SSE JSON body (429/4xx/5xx responses)
+    body: Optional[dict] = None
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.headers.get("x-trace-id")
+
+    @property
+    def retry_after_s(self) -> Optional[int]:
+        v = self.headers.get("retry-after")
+        return int(v) if v is not None else None
+
+    @property
+    def tokens(self) -> List[int]:
+        return [d["token"] for ev, d in self.events if ev == "token"]
+
+    @property
+    def positions(self) -> List[int]:
+        return [d["pos"] for ev, d in self.events if ev == "token"]
+
+    @property
+    def terminal(self) -> Optional[Tuple[str, dict]]:
+        """The ``done`` or ``error`` event, if the stream terminated."""
+        for ev, d in reversed(self.events):
+            if ev in ("done", "error"):
+                return ev, d
+        return None
+
+
+async def _read_headers(reader) -> Tuple[int, Dict[str, str]]:
+    status_line = await reader.readline()
+    status = int(status_line.decode("latin-1").split()[1])
+    headers: Dict[str, str] = {}
+    while True:
+        h = await reader.readline()
+        if h in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = h.decode("latin-1").partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return status, headers
+
+
+async def generate(host: str, port: int, prompt: List[int], *,
+                   api_key: Optional[str] = None,
+                   tenant: Optional[str] = None,
+                   max_new_tokens: int = 8,
+                   greedy: bool = True,
+                   priority_class: Optional[str] = None,
+                   deadline_s: Optional[float] = None,
+                   seed: Optional[int] = None,
+                   on_event=None,
+                   timeout_s: float = 60.0) -> GatewayResponse:
+    """POST one generate request and consume the response to EOF.
+
+    ``on_event(event, data)`` fires per SSE event as it arrives (for
+    tests that act mid-stream — e.g. killing a replica after the first
+    few tokens).  Returns the full :class:`GatewayResponse`.
+    """
+    spec: dict = {"prompt": [int(t) for t in prompt],
+                  "max_new_tokens": int(max_new_tokens),
+                  "greedy": bool(greedy)}
+    if priority_class is not None:
+        spec["priority_class"] = priority_class
+    if deadline_s is not None:
+        spec["deadline_s"] = float(deadline_s)
+    if seed is not None:
+        spec["seed"] = int(seed)
+    body = json.dumps(spec).encode("utf-8")
+    head = ["POST /v1/generate HTTP/1.1",
+            f"Host: {host}:{port}",
+            "Content-Type: application/json",
+            f"Content-Length: {len(body)}",
+            "Connection: close"]
+    if api_key is not None:
+        head.append(f"Authorization: Bearer {api_key}")
+    if tenant is not None:
+        head.append(f"X-Tenant: {tenant}")
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
+                     + body)
+        await writer.drain()
+        status, headers = await asyncio.wait_for(_read_headers(reader),
+                                                 timeout_s)
+        resp = GatewayResponse(status=status, headers=headers)
+        ctype = headers.get("content-type", "")
+        if "text/event-stream" not in ctype:
+            raw = await asyncio.wait_for(reader.read(), timeout_s)
+            if raw:
+                try:
+                    resp.body = json.loads(raw.decode("utf-8"))
+                except ValueError:
+                    resp.body = {"raw": raw.decode("utf-8", "replace")}
+            return resp
+        # SSE: "event: <name>\n" then "data: <json>\n" then blank line,
+        # until the server closes the connection after the terminal event
+        event: Optional[str] = None
+        while True:
+            line = await asyncio.wait_for(reader.readline(), timeout_s)
+            if not line:
+                return resp
+            line = line.decode("utf-8").rstrip("\n").rstrip("\r")
+            if line.startswith("event: "):
+                event = line[7:]
+            elif line.startswith("data: ") and event is not None:
+                data = json.loads(line[6:])
+                resp.events.append((event, data))
+                if on_event is not None:
+                    on_event(event, data)
+                event = None
+    finally:
+        try:
+            writer.close()
+            await writer.wait_closed()
+        except (ConnectionResetError, BrokenPipeError):
+            pass

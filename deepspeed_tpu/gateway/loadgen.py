@@ -1,0 +1,328 @@
+"""Recorded-trace load harness: record real multi-tenant request traces,
+reshape them (burst / diurnal / load scaling), replay them open-loop.
+
+Poisson arrivals flatter a serving stack: real traffic arrives in
+bursts, breathes diurnally, and reuses sessions (shared prefixes).  The
+harness's unit of work is therefore a TRACE — a list of
+:class:`TraceRequest` records (arrival offset, tenant, priority class,
+prompt/output lengths, session id) that can be
+
+* **recorded** from any live run (:meth:`RequestTrace.record_fleet` —
+  the fleet's journal already holds arrivals, lengths, tenants,
+  priorities);
+* **reshaped** deterministically (:meth:`RequestTrace.shaped`: load
+  scaling compresses offsets, burst shaping packs each period's
+  arrivals into its head, diurnal shaping time-warps density
+  sinusoidally);
+* **replayed** open-loop against a :class:`ServingFleet` (or anything
+  fleet-shaped) by :func:`replay`: submissions fire at their offsets
+  whether or not earlier ones finished — exactly the regime where
+  backpressure must shed batch-class first — and the report carries
+  per-class TTFT/TPOT percentiles, shed/429 counts by class, and
+  goodput.
+
+The trace file is JSONL (one header line + one line per request), so
+traces diff cleanly and concatenate with ``cat``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import json
+import math
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.fleet.defense import OverloadShedError
+from deepspeed_tpu.serving.request import SamplingParams
+from deepspeed_tpu.serving.router import QuotaExceededError
+
+_TRACE_VERSION = 1
+
+#: numeric priority -> class name (the DEFAULT_PRIORITY_CLASSES mapping,
+#: inverted — recording reads priorities off the journal)
+_CLASS_BY_PRIORITY = {10: "interactive", 0: "standard", -10: "batch"}
+
+
+@dataclasses.dataclass
+class TraceRequest:
+    """One recorded arrival."""
+
+    offset_s: float                      # arrival offset from trace start
+    tenant: str = "default"
+    priority_class: str = "standard"
+    prompt_len: int = 8
+    max_new_tokens: int = 8
+    #: session id: requests sharing one reuse a prompt prefix (radix
+    #: cache traffic); None = independent prompt
+    session: Optional[str] = None
+    seed: int = 0                        # keys the synthetic prompt ids
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, line: str) -> "TraceRequest":
+        return cls(**json.loads(line))
+
+
+class RequestTrace:
+    """An ordered list of :class:`TraceRequest` + provenance metadata."""
+
+    def __init__(self, requests: List[TraceRequest],
+                 meta: Optional[dict] = None):
+        self.requests = sorted(requests, key=lambda r: r.offset_s)
+        self.meta = dict(meta or {})
+
+    def __len__(self) -> int:
+        return len(self.requests)
+
+    @property
+    def duration_s(self) -> float:
+        return self.requests[-1].offset_s if self.requests else 0.0
+
+    # ------------------------------------------------------------------ #
+    # Persistence (JSONL: header line + one line per request)
+    # ------------------------------------------------------------------ #
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(json.dumps({"gateway_trace": _TRACE_VERSION,
+                                "requests": len(self.requests),
+                                **self.meta}) + "\n")
+            for r in self.requests:
+                f.write(r.to_json() + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "RequestTrace":
+        with open(path) as f:
+            header = json.loads(f.readline())
+            if header.get("gateway_trace") != _TRACE_VERSION:
+                raise ValueError(
+                    f"{path}: not a gateway trace (header {header})")
+            reqs = [TraceRequest.from_json(line) for line in f
+                    if line.strip()]
+        meta = {k: v for k, v in header.items()
+                if k not in ("gateway_trace", "requests")}
+        return cls(reqs, meta)
+
+    # ------------------------------------------------------------------ #
+    # Recording from a live run
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def record_fleet(cls, fleet) -> "RequestTrace":
+        """Build a trace from a fleet's journal: every request ever
+        submitted (live or terminal), offsets relative to the earliest
+        arrival, lengths/tenants/priorities as admitted."""
+        frs = list(fleet.requests)
+        if not frs:
+            return cls([], {"source": "fleet", "recorded": 0})
+        t0 = min(fr.arrival for fr in frs)
+        reqs = [TraceRequest(
+            offset_s=round(fr.arrival - t0, 6), tenant=fr.tenant,
+            priority_class=_CLASS_BY_PRIORITY.get(fr.priority, "standard"),
+            prompt_len=len(fr.prompt),
+            max_new_tokens=fr.sampling.max_new_tokens,
+            seed=fr.uid) for fr in frs]
+        return cls(reqs, {"source": "fleet", "recorded": len(reqs)})
+
+    # ------------------------------------------------------------------ #
+    # Shaping (all deterministic, all offset-only)
+    # ------------------------------------------------------------------ #
+    def shaped(self, *, load: float = 1.0,
+               burst_factor: Optional[float] = None,
+               burst_period_s: Optional[float] = None,
+               diurnal_depth: Optional[float] = None,
+               diurnal_period_s: Optional[float] = None) -> "RequestTrace":
+        """A reshaped copy.
+
+        * ``load`` — open-loop rate multiplier: offsets divide by it
+          (2.0 = the same trace arriving twice as fast).
+        * ``burst_factor``/``burst_period_s`` — within each period, the
+          period's arrivals compress into its first ``1/factor``: the
+          same average rate delivered as periodic bursts.
+        * ``diurnal_depth``/``diurnal_period_s`` — sinusoidal time warp
+          ``o' = o - depth * P/(2π) * sin(2π o / P)`` (monotone for
+          depth < 1): arrival density swings by ±depth around the mean,
+          the trace's day/night breathing.
+        """
+        out = []
+        for r in self.requests:
+            o = r.offset_s / max(load, 1e-9)
+            if burst_factor is not None and burst_period_s:
+                p = burst_period_s
+                o = math.floor(o / p) * p + (o % p) / max(burst_factor,
+                                                          1.0)
+            if diurnal_depth is not None and diurnal_period_s:
+                if not 0.0 <= diurnal_depth < 1.0:
+                    raise ValueError("diurnal_depth must be in [0, 1)")
+                w = 2.0 * math.pi / diurnal_period_s
+                o = o - diurnal_depth / w * math.sin(w * o)
+            out.append(dataclasses.replace(r, offset_s=round(o, 6)))
+        meta = {**self.meta, "shaped": {
+            "load": load, "burst_factor": burst_factor,
+            "burst_period_s": burst_period_s,
+            "diurnal_depth": diurnal_depth,
+            "diurnal_period_s": diurnal_period_s}}
+        return RequestTrace(out, meta)
+
+
+# --------------------------------------------------------------------- #
+# Synthetic traces (for tests and the smoke's recorded-run seed)
+# --------------------------------------------------------------------- #
+def synth_trace(n: int, *, seed: int = 0, duration_s: float = 1.0,
+                tenants=("acme", "beta"),
+                mix: Optional[Dict[str, float]] = None,
+                prompt_len=(6, 14), max_new_tokens=(4, 10),
+                session_reuse_p: float = 0.3) -> RequestTrace:
+    """A deterministic multi-tenant trace: uniform arrivals over
+    ``duration_s``, class mix by probability, per-tenant session reuse
+    with probability ``session_reuse_p``."""
+    mix = mix or {"interactive": 0.4, "standard": 0.3, "batch": 0.3}
+    classes = sorted(mix)
+    probs = np.asarray([mix[c] for c in classes], np.float64)
+    probs = probs / probs.sum()
+    rng = np.random.default_rng(seed)
+    sessions: Dict[str, int] = {}
+    reqs = []
+    for i in range(n):
+        tenant = str(tenants[int(rng.integers(len(tenants)))])
+        cls = classes[int(rng.choice(len(classes), p=probs))]
+        if tenant in sessions and rng.random() < session_reuse_p:
+            sess: Optional[str] = f"{tenant}/s{sessions[tenant]}"
+        else:
+            sessions[tenant] = sessions.get(tenant, -1) + 1
+            sess = f"{tenant}/s{sessions[tenant]}"
+        reqs.append(TraceRequest(
+            offset_s=round(float(rng.uniform(0.0, duration_s)), 6),
+            tenant=tenant, priority_class=cls,
+            prompt_len=int(rng.integers(prompt_len[0], prompt_len[1] + 1)),
+            max_new_tokens=int(rng.integers(max_new_tokens[0],
+                                            max_new_tokens[1] + 1)),
+            session=sess, seed=i))
+    return RequestTrace(reqs, {"source": "synth", "seed": seed, "n": n})
+
+
+def _pct(vals: List[float], q: float) -> float:
+    return float(np.percentile(np.asarray(vals, np.float64), q))
+
+
+def _session_prompt(r: TraceRequest, vocab: int,
+                    prefix_cache: Dict[str, List[int]]) -> List[int]:
+    """Deterministic token ids; same-session requests share a prefix
+    (half the prompt), so replay exercises the radix cache the way the
+    recorded traffic did."""
+    rng = np.random.default_rng(r.seed + 1)
+    if r.session is None:
+        return rng.integers(0, vocab, size=(r.prompt_len,)).tolist()
+    half = max(r.prompt_len // 2, 1)
+    if r.session not in prefix_cache:
+        srng = np.random.default_rng(abs(hash(r.session)) % (2 ** 31))
+        prefix_cache[r.session] = srng.integers(
+            0, vocab, size=(half,)).tolist()
+    prefix = prefix_cache[r.session][:half]
+    tail = rng.integers(0, vocab,
+                        size=(max(r.prompt_len - len(prefix), 1),)).tolist()
+    return prefix + tail
+
+
+def replay(trace: RequestTrace, backend, *, speed: float = 1.0,
+           vocab: int = 512, greedy: bool = True,
+           max_wall_s: float = 120.0, drain: bool = True) -> dict:
+    """Open-loop replay: each request submits at ``offset_s / speed``
+    wall seconds after start, regardless of how the fleet is doing —
+    overload therefore lands on the admission machinery, not on a
+    closed-loop client's politeness.  Returns the harness report.
+
+    ``backend`` is fleet-shaped (``submit``/``step``/``num_pending``);
+    kwargs its ``submit`` does not take (priority_class on a
+    FleetFrontEnd) degrade away instead of crashing.
+    """
+    try:
+        accepted = frozenset(inspect.signature(backend.submit).parameters)
+    except (TypeError, ValueError):
+        accepted = frozenset()
+    prefix_cache: Dict[str, List[int]] = {}
+    pending = list(trace.requests)          # sorted by offset
+    handles = []                            # (TraceRequest, FleetRequest)
+    sheds: Dict[str, int] = {}
+    shed_retry_after: List[float] = []
+    quota_rejects = 0
+    t0 = time.monotonic()
+    while pending or (drain and backend.num_pending):
+        now = time.monotonic() - t0
+        if now > max_wall_s:
+            break
+        while pending and pending[0].offset_s / speed <= now:
+            r = pending.pop(0)
+            kw = {"tenant": r.tenant, "priority_class": r.priority_class,
+                  "sampling": SamplingParams(
+                      greedy=greedy, max_new_tokens=r.max_new_tokens,
+                      seed=r.seed)}
+            kw = {k: v for k, v in kw.items() if k in accepted}
+            try:
+                handles.append(
+                    (r, backend.submit(
+                        _session_prompt(r, vocab, prefix_cache), **kw)))
+            except OverloadShedError as e:
+                sheds[r.priority_class] = \
+                    sheds.get(r.priority_class, 0) + 1
+                shed_retry_after.append(float(e.retry_after_s))
+            except QuotaExceededError:
+                quota_rejects += 1
+        if backend.num_pending:
+            backend.step()
+        else:
+            time.sleep(0.0005)
+    wall = time.monotonic() - t0
+    # ------------------------------------------------------------------ #
+    # Report: per-class percentiles, sheds, goodput
+    # ------------------------------------------------------------------ #
+    by_class: Dict[str, dict] = {}
+    finished = failed = tokens_out = 0
+    for r, fr in handles:
+        c = by_class.setdefault(r.priority_class,
+                                {"submitted": 0, "finished": 0,
+                                 "failed": 0, "ttft_s": [], "tpot_s": []})
+        c["submitted"] += 1
+        state = getattr(fr.state, "value", fr.state)
+        if state == "finished":
+            c["finished"] += 1
+            finished += 1
+            tokens_out += len(fr.tokens)
+            if fr.ttft is not None:
+                c["ttft_s"].append(fr.ttft)
+            if fr.tpot is not None:
+                c["tpot_s"].append(fr.tpot)
+        elif state == "failed":
+            c["failed"] += 1
+            failed += 1
+    classes_report = {}
+    for cls, c in sorted(by_class.items()):
+        rep = {"submitted": c["submitted"], "finished": c["finished"],
+               "failed": c["failed"], "shed": sheds.get(cls, 0)}
+        for name in ("ttft_s", "tpot_s"):
+            if c[name]:
+                rep[f"p50_{name}"] = round(_pct(c[name], 50), 6)
+                rep[f"p95_{name}"] = round(_pct(c[name], 95), 6)
+        classes_report[cls] = rep
+    for cls, n in sheds.items():            # shed before any handle
+        classes_report.setdefault(cls, {"submitted": 0, "finished": 0,
+                                        "failed": 0, "shed": n})
+    return {
+        "requests": len(trace.requests),
+        "submitted": len(handles),
+        "finished": finished,
+        "failed": failed,
+        "shed_total": int(sum(sheds.values())),
+        "sheds_by_class": dict(sorted(sheds.items())),
+        "shed_retry_after_p50_s": (round(_pct(shed_retry_after, 50), 4)
+                                   if shed_retry_after else None),
+        "quota_rejects": quota_rejects,
+        "goodput_tokens_per_s": round(tokens_out / max(wall, 1e-9), 2),
+        "tokens_out": tokens_out,
+        "wall_s": round(wall, 3),
+        "classes": classes_report,
+    }

@@ -1,0 +1,77 @@
+"""The ``gateway/*`` metric namespace: the HTTP edge's own telemetry.
+
+Declares every name the gateway can emit into the unified
+:class:`~deepspeed_tpu.observability.registry.MetricsRegistry` at import
+time — the contract dslint's metric-name pass checks string literals
+against (``analysis/metrics_lint.py`` imports this module in
+``declared_specs()``), exactly as the serving/fleet/resilience/
+observability namespaces do.
+
+:class:`GatewayMetrics` is the live counter set one
+:class:`~deepspeed_tpu.gateway.server.GatewayServer` maintains;
+``telemetry()`` is registry-provider-shaped (full ``gateway/<name>``
+keys) so the server can register it under the ``"gateway"`` provider
+key and the edge shows up in the same ``snapshot()`` /
+``to_prometheus()`` surface as everything behind it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from deepspeed_tpu.observability.registry import MetricsRegistry
+
+
+def _declare(reg: MetricsRegistry) -> None:
+    """Declare every ``gateway/*`` name this module can emit."""
+    for n in ("requests", "streams_started", "streams_finished",
+              "streams_failed", "tokens_streamed",
+              "duplicates_suppressed", "rejected_auth", "rejected_quota",
+              "sheds_429", "deadline_expired", "bad_requests"):
+        reg.counter(f"gateway/{n}")
+    reg.gauge("gateway/open_streams")
+    #: trace-replay harness percentiles (loadgen reports), declared as
+    #: families like serving's rolling percentile series
+    reg.histogram("gateway/p50_*", help="replay percentile series")
+    reg.histogram("gateway/p95_*", help="replay percentile series")
+    reg.gauge("gateway/replay_*", help="trace-replay harness scalars")
+
+
+_declare(MetricsRegistry.default())
+
+
+class GatewayMetrics:
+    """Edge counters for one gateway instance (host-side, no locks: the
+    server mutates them from its single event loop; the fleet pump runs
+    in that same loop)."""
+
+    def __init__(self) -> None:
+        self.requests = 0              # HTTP requests parsed
+        self.streams_started = 0       # 200s that began streaming
+        self.streams_finished = 0      # streams that ended "finished"
+        self.streams_failed = 0        # streams that ended in error event
+        self.tokens_streamed = 0       # SSE token events written
+        self.duplicates_suppressed = 0  # bridge (uid, position) dedupe
+        self.rejected_auth = 0         # 401s
+        self.rejected_quota = 0        # 429s from TenantQuota
+        self.sheds_429 = 0             # 429s from AdmissionBudget
+        self.deadline_expired = 0      # streams failed reason="deadline"
+        self.bad_requests = 0          # 400/404/413s
+        self.open_streams = 0          # live SSE connections right now
+
+    def telemetry(self) -> Dict[str, float]:
+        return {
+            "gateway/requests": float(self.requests),
+            "gateway/streams_started": float(self.streams_started),
+            "gateway/streams_finished": float(self.streams_finished),
+            "gateway/streams_failed": float(self.streams_failed),
+            "gateway/tokens_streamed": float(self.tokens_streamed),
+            "gateway/duplicates_suppressed":
+                float(self.duplicates_suppressed),
+            "gateway/rejected_auth": float(self.rejected_auth),
+            "gateway/rejected_quota": float(self.rejected_quota),
+            "gateway/sheds_429": float(self.sheds_429),
+            "gateway/deadline_expired": float(self.deadline_expired),
+            "gateway/bad_requests": float(self.bad_requests),
+            "gateway/open_streams": float(self.open_streams),
+        }

@@ -138,6 +138,21 @@ ROWS: List[MatrixRow] = [
         cpu_ok=True,
         timeout_s=900.0),
     MatrixRow(
+        name="serving_gateway_replayed_burst",
+        milestone="ROADMAP: HTTP/SSE gateway + recorded-trace load "
+                  "harness (2x replayed burst through admission "
+                  "control)",
+        metric="serving_gateway_replay_goodput_tokens_per_sec",
+        argv=["tools/gateway_smoke.py", "--replay"],
+        cpu_ok=True,
+        timeout_s=600.0,
+        unavailable_reason="recorded-trace replay numbers on CPU-host "
+                           "tiny-Llama measure the harness, not the "
+                           "serving stack — PERFLOG round 20 carries "
+                           "them; the row goes live (drop this reason) "
+                           "with the next TPU driver round, replaying "
+                           "a chip-recorded trace against a real fleet"),
+    MatrixRow(
         name="moe_mixtral_8x7b",
         milestone="BASELINE: DeepSpeed-MoE Mixtral-8x7B expert-parallel "
                   "all-to-all over ICI",
