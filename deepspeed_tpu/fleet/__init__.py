@@ -29,8 +29,18 @@ quarantine (:class:`CrashBlame`), per-replica circuit breakers
 (:class:`AdmissionBudget` → :class:`OverloadShedError` with retry-after
 hints), all driven deterministically by the ``poison_request`` /
 ``tick_stall`` / ``spawn_fail`` chaos fault points.
+
+Elastic capacity (:meth:`ServingFleet.set_replica_count`, driven by
+:class:`FleetAutoscaler`): scale-up spawns real replicas (breaker- and
+budget-gated), scale-down drains the victim gracefully and migrates its
+leftovers; the staged :class:`BrownoutController`
+(:mod:`deepspeed_tpu.fleet.brownout`) degrades quality under pressure
+while capacity arrives.  Chaos points ``drain_stall`` /
+``scale_spawn_slow`` drive the scale-event failure modes
+deterministically.
 """
 
+from deepspeed_tpu.fleet.brownout import BrownoutController
 from deepspeed_tpu.fleet.defense import (AdmissionBudget, BreakerState,
                                          CircuitBreaker, CrashBlame,
                                          OverloadShedError,
@@ -41,8 +51,8 @@ from deepspeed_tpu.fleet.fleet import (FleetRequest, SchedulerFactory,
 from deepspeed_tpu.fleet.metrics import FleetMetrics
 from deepspeed_tpu.fleet.worker import FleetFrontEnd, run_replica_worker
 
-__all__ = ["AdmissionBudget", "BreakerState", "CircuitBreaker",
-           "CrashBlame", "FleetAutoscaler", "FleetFrontEnd",
-           "FleetMetrics", "FleetRequest", "OverloadShedError",
-           "QuarantinedError", "SchedulerFactory", "ServingFleet",
-           "run_replica_worker"]
+__all__ = ["AdmissionBudget", "BreakerState", "BrownoutController",
+           "CircuitBreaker", "CrashBlame", "FleetAutoscaler",
+           "FleetFrontEnd", "FleetMetrics", "FleetRequest",
+           "OverloadShedError", "QuarantinedError", "SchedulerFactory",
+           "ServingFleet", "run_replica_worker"]

@@ -1,0 +1,287 @@
+"""Staged brownout: degrade service quality instead of falling over.
+
+When load outruns capacity, a fleet has exactly three levers: shed,
+degrade, or scale.  The autoscaler pulls the third, but real capacity
+takes seconds-to-minutes to arrive (engine spawn, checkpoint read,
+warmup) — the :class:`BrownoutController` pulls the second in the
+meantime, walking a five-stage ladder of progressively harsher (and
+fully reversible) quality cuts:
+
+1. **shed batch harder** — the :class:`~deepspeed_tpu.fleet.defense.
+   AdmissionBudget` ceiling for the ``batch`` class drops, so bulk work
+   sheds long before interactive traffic feels anything;
+2. **shrink speculative lookahead** — every scheduler's draft K is
+   capped (``set_spec_k_cap``): less wasted verify work under pressure;
+3. **disable speculation + cap prefill** — speculation off entirely
+   (``set_speculative_enabled(False)``) and the SplitFuse per-tick
+   token budget cut (``set_token_budget``), so decode latency wins over
+   prefill throughput;
+4. **tighten admission** — new requests get their ``max_new_tokens``
+   clamped and over-long prompts are rejected retryably
+   (``set_admission_caps``): shorter answers, not dropped streams;
+5. **429 the standard class** — the ``standard`` ceiling drops to a
+   sliver; only interactive traffic is still admitted at full rate.
+
+The ladder is driven by measured signals — interactive p95 TTFT vs its
+SLO, per-replica queue depth, shed rate — folded into one *pressure*
+ratio (how far the worst signal sits beyond its threshold).  Transitions
+are hysteresis-guarded three ways so an oscillating signal cannot flap
+the fleet:
+
+* **dwell**: pressure must hold above 1.0 for ``enter_patience``
+  consecutive observations to climb a stage, and below
+  ``exit_fraction`` for ``exit_patience`` to descend one;
+* **one step at a time**: stages engage 1→5 and disengage 5→1 in
+  strict reverse order — a pressure spike never jumps the ladder;
+* **transition budget**: moves draw from a sliding-window
+  :class:`~deepspeed_tpu.resilience.supervisor.RestartBudget`; past it
+  the controller holds its stage until the window slides.
+
+Every transition lands on the fleet tracer (a ``brownout/stage<k>``
+span covering the stage's residency plus a transition instant) and in
+the ``fleet/brownout_*`` metrics (stage gauge, per-stage entry/exit
+counters) via the attached :class:`~deepspeed_tpu.fleet.metrics.
+FleetMetrics`.
+
+The controller is deliberately fleet-agnostic: :meth:`observe` takes a
+signals dict and the live scheduler list, so tests drive it with
+synthetic series, and an elastically-spawned replica inherits the
+current stage through :meth:`apply_current`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, List, Optional
+
+from deepspeed_tpu.resilience.supervisor import RestartBudget
+from deepspeed_tpu.utils.logging import logger
+
+#: the ladder's depth; stage 0 is "healthy, no degradation"
+NUM_STAGES = 5
+
+
+class BrownoutController:
+    """Hysteresis-guarded degradation ladder (see module doc).
+
+    Signals consumed by :meth:`observe` (missing keys read as zero
+    pressure):
+
+    ``p95_ttft_interactive_s``
+        recent interactive p95 time-to-first-token, including the
+        age of interactive requests still waiting on their first token;
+    ``queue_per_replica``
+        token backlog per live replica (the autoscaler's own signal);
+    ``shed_per_s``
+        recent overload sheds per second.
+    """
+
+    def __init__(self, *,
+                 ttft_slo_s: float = 2.0,
+                 queue_high: float = 512.0,
+                 shed_high_per_s: float = 2.0,
+                 exit_fraction: float = 0.5,
+                 enter_patience: int = 2,
+                 exit_patience: int = 3,
+                 max_transitions: int = 10,
+                 transition_window_s: float = 60.0,
+                 batch_ceiling: float = 0.15,
+                 standard_ceiling: float = 0.02,
+                 spec_k_cap: int = 1,
+                 token_budget_fraction: float = 0.5,
+                 max_new_tokens_cap: int = 32,
+                 max_context_cap: Optional[int] = None,
+                 clock=time.monotonic):
+        if ttft_slo_s <= 0 or queue_high <= 0 or shed_high_per_s <= 0:
+            raise ValueError("brownout signal thresholds must be > 0")
+        if not 0.0 < exit_fraction < 1.0:
+            raise ValueError(
+                f"exit_fraction ({exit_fraction}) must sit strictly inside "
+                "(0, 1) — the gap below the enter threshold IS the "
+                "hysteresis")
+        if enter_patience < 1 or exit_patience < 1:
+            raise ValueError("patience values must be >= 1")
+        if not 0.0 < token_budget_fraction <= 1.0:
+            raise ValueError("token_budget_fraction must be in (0, 1]")
+        self.ttft_slo_s = float(ttft_slo_s)
+        self.queue_high = float(queue_high)
+        self.shed_high_per_s = float(shed_high_per_s)
+        self.exit_fraction = float(exit_fraction)
+        self.enter_patience = int(enter_patience)
+        self.exit_patience = int(exit_patience)
+        self.budget = RestartBudget(max_transitions, transition_window_s)
+        # -- stage knob values ------------------------------------------- #
+        self.batch_ceiling = float(batch_ceiling)
+        self.standard_ceiling = float(standard_ceiling)
+        self.spec_k_cap = int(spec_k_cap)
+        self.token_budget_fraction = float(token_budget_fraction)
+        self.max_new_tokens_cap = int(max_new_tokens_cap)
+        self.max_context_cap = max_context_cap
+        self._clock = clock
+        # -- wired by attach() ------------------------------------------- #
+        self.admission = None
+        self.tracer = None
+        self.metrics = None
+        # -- state ------------------------------------------------------- #
+        self.stage = 0
+        self._hot = 0       # consecutive observations with pressure >= 1
+        self._cool = 0      # consecutive observations below the exit bar
+        self.observations = 0
+        self.transitions = 0
+        self.held_by_budget = 0
+        self.last_pressure = 0.0
+        #: saved AdmissionBudget ceilings, restored on stage exit
+        self._saved_ceilings: Dict[str, float] = {}
+        #: open tracer span per engaged stage (index 0 = stage 1)
+        self._stage_spans: List = []
+
+    # ------------------------------------------------------------------ #
+    def attach(self, *, admission=None, tracer=None, metrics=None) -> None:
+        """Wire the fleet-side actuation/telemetry handles.  ``admission``
+        is the fleet's AdmissionBudget (stages 1/5 mutate its class
+        ceilings); ``tracer``/``metrics`` receive the transition spans
+        and ``fleet/brownout_*`` samples."""
+        if admission is not None:
+            self.admission = admission
+        if tracer is not None:
+            self.tracer = tracer
+        if metrics is not None:
+            self.metrics = metrics
+
+    # ------------------------------------------------------------------ #
+    def pressure(self, signals: Dict[str, float]) -> float:
+        """One scalar: how far the WORST signal sits beyond its
+        threshold (1.0 = exactly at the bar)."""
+        return max(
+            float(signals.get("p95_ttft_interactive_s", 0.0))
+            / self.ttft_slo_s,
+            float(signals.get("queue_per_replica", 0.0)) / self.queue_high,
+            float(signals.get("shed_per_s", 0.0)) / self.shed_high_per_s)
+
+    def observe(self, signals: Dict[str, float],
+                schedulers: Iterable = (),
+                now: Optional[float] = None) -> int:
+        """Feed one observation; walks the ladder at most ONE step and
+        applies/reverts that stage's knobs on ``schedulers`` + the
+        attached admission budget.  Returns the (possibly new) stage."""
+        now = self._clock() if now is None else now
+        self.observations += 1
+        p = self.last_pressure = self.pressure(signals)
+        if p >= 1.0:
+            self._hot += 1
+            self._cool = 0
+        elif p <= self.exit_fraction:
+            self._cool += 1
+            self._hot = 0
+        else:
+            # the hysteresis band: hold, and make both dwell counters
+            # start over — wobbling across one bar is not a trend
+            self._hot = self._cool = 0
+        target = self.stage
+        if self._hot >= self.enter_patience and self.stage < NUM_STAGES:
+            target = self.stage + 1
+        elif self._cool >= self.exit_patience and self.stage > 0:
+            target = self.stage - 1
+        if target == self.stage:
+            return self.stage
+        if self.budget.exhausted(now):
+            self.held_by_budget += 1
+            return self.stage
+        self.budget.record(now)
+        self._hot = self._cool = 0
+        scheds = list(schedulers)
+        if target > self.stage:
+            self._enter_stage(target, scheds, p)
+        else:
+            self._exit_stage(self.stage, scheds, p)
+        self.stage = target
+        if self.metrics is not None:
+            self.metrics.record_brownout(target)
+        return self.stage
+
+    def apply_current(self, schedulers: Iterable) -> None:
+        """Enforce every engaged stage's scheduler knobs on
+        ``schedulers`` — an elastically-spawned replica must join the
+        fleet already degraded, not serve at full quality while its
+        siblings brown out."""
+        for k in range(1, self.stage + 1):
+            self._apply_sched_knobs(k, list(schedulers), enter=True)
+
+    # ------------------------------------------------------------------ #
+    # Stage actions
+    # ------------------------------------------------------------------ #
+    def _apply_sched_knobs(self, stage: int, scheds: List,
+                           enter: bool) -> None:
+        for s in scheds:
+            if stage == 2:
+                s.set_spec_k_cap(self.spec_k_cap if enter else None)
+            elif stage == 3:
+                s.set_speculative_enabled(not enter)
+                s.set_token_budget(
+                    max(1, int(s._base_token_budget
+                               * self.token_budget_fraction))
+                    if enter else None)
+            elif stage == 4:
+                if enter:
+                    s.set_admission_caps(self.max_new_tokens_cap,
+                                         self.max_context_cap)
+                else:
+                    s.set_admission_caps(None, None)
+
+    def _enter_stage(self, stage: int, scheds: List,
+                     pressure: float) -> None:
+        self.transitions += 1
+        if stage == 1 and self.admission is not None:
+            self._saved_ceilings["batch"] = \
+                self.admission.ceiling("batch")
+            self.admission.class_ceilings["batch"] = self.batch_ceiling
+        elif stage == 5 and self.admission is not None:
+            self._saved_ceilings["standard"] = \
+                self.admission.ceiling("standard")
+            self.admission.class_ceilings["standard"] = \
+                self.standard_ceiling
+        self._apply_sched_knobs(stage, scheds, enter=True)
+        if self.tracer is not None:
+            self._stage_spans.append(self.tracer.start(
+                f"brownout/stage{stage}", tid="fleet",
+                attrs={"pressure": round(pressure, 3)}))
+            self.tracer.instant(
+                "brownout/transition", tid="fleet",
+                attrs={"from": stage - 1, "to": stage,
+                       "pressure": round(pressure, 3)})
+        logger.warning(f"brownout: ENTER stage {stage} "
+                       f"(pressure {pressure:.2f})")
+
+    def _exit_stage(self, stage: int, scheds: List,
+                    pressure: float) -> None:
+        self.transitions += 1
+        if stage == 1 and self.admission is not None \
+                and "batch" in self._saved_ceilings:
+            self.admission.class_ceilings["batch"] = \
+                self._saved_ceilings.pop("batch")
+        elif stage == 5 and self.admission is not None \
+                and "standard" in self._saved_ceilings:
+            self.admission.class_ceilings["standard"] = \
+                self._saved_ceilings.pop("standard")
+        self._apply_sched_knobs(stage, scheds, enter=False)
+        if self.tracer is not None:
+            if self._stage_spans:
+                self.tracer.finish(self._stage_spans.pop(),
+                                   attrs={"exit_pressure":
+                                          round(pressure, 3)})
+            self.tracer.instant(
+                "brownout/transition", tid="fleet",
+                attrs={"from": stage, "to": stage - 1,
+                       "pressure": round(pressure, 3)})
+        logger.info(f"brownout: EXIT stage {stage} "
+                    f"(pressure {pressure:.2f})")
+
+    # ------------------------------------------------------------------ #
+    def telemetry(self) -> Dict[str, float]:
+        """``fleet/brownout_*`` scalars for the metrics snapshot."""
+        return {
+            "fleet/brownout_stage": float(self.stage),
+            "fleet/brownout_transitions": float(self.transitions),
+            "fleet/brownout_held": float(self.held_by_budget),
+            "fleet/brownout_pressure": float(self.last_pressure),
+        }

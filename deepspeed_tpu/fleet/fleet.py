@@ -194,6 +194,9 @@ class ServingFleet:
                  restart_budget: Optional[RestartBudget] = None,
                  startup_window_s: float = 5.0,
                  admission: Optional[AdmissionBudget] = None,
+                 brownout=None,
+                 brownout_every: int = 4,
+                 scale_drain_deadline_s: float = 5.0,
                  tracer: Optional[Tracer] = None,
                  postmortem_dir: Optional[str] = None,
                  flight_spans: int = 128,
@@ -277,6 +280,22 @@ class ServingFleet:
         #: sheds lowest priority class first BEFORE the router's
         #: per-replica SLO admission ever sees the request
         self.admission = admission
+        # -- elastic capacity / brownout -------------------------------- #
+        #: staged degradation ladder (see fleet.brownout) observing the
+        #: same pressure signals the autoscaler scales on — brownout buys
+        #: time while real capacity arrives
+        self.brownout = brownout
+        self.brownout_every = int(brownout_every)
+        #: graceful scale-down: how long a downsize victim gets to finish
+        #: its in-flight work before leftovers are detached and migrated
+        self.scale_drain_deadline_s = float(scale_drain_deadline_s)
+        #: scale-up spawn gate: repeated factory failures under load must
+        #: open a breaker (stop hammering a sick host/image), not retry
+        #: forever — separate from the per-replica respawn breakers
+        self.scale_breaker = CircuitBreaker(**self._breaker_kwargs)
+        #: (shed_total, monotonic time) at the last brownout observation
+        #: — the shed-rate signal is a windowed delta, not a lifetime sum
+        self._last_shed_obs: Tuple[int, float] = (0, time.monotonic())
         self._respawned_at: Dict[str, float] = {}
         #: poison-suspect uids awaiting an isolation probe, FIFO
         self._suspect_queue: List[int] = []
@@ -297,6 +316,9 @@ class ServingFleet:
         #: per-replica incarnation counter (span tid suffix)
         self._incarnation: Dict[str, int] = {}
         self._postmortem_seq = itertools.count()
+        if self.brownout is not None:
+            self.brownout.attach(admission=self.admission,
+                                 tracer=self.tracer, metrics=self.metrics)
         if registry is not None:
             registry.register_provider("fleet",
                                        lambda: self.metrics.snapshot(self))
@@ -477,6 +499,12 @@ class ServingFleet:
         self._release_probes()
         self._pump_probes()
         self._tick += 1
+        if self.brownout is not None \
+                and self._tick % self.brownout_every == 0:
+            self.brownout.observe(
+                self._brownout_signals(),
+                [rep.scheduler for _, rep in self.pool_members()
+                 if not rep.broken])
         if self.autoscaler is not None \
                 and self._tick % self.autoscale_every == 0:
             self._autoscale()
@@ -1054,6 +1082,32 @@ class ServingFleet:
             return self.decode_router, "decode"
         return self.router, "replica"
 
+    def _brownout_signals(self) -> Dict[str, float]:
+        """The brownout controller's measured inputs, computed from LIVE
+        fleet state (present pressure, not lifetime averages):
+        interactive p95 TTFT where a request still waiting on its first
+        token counts at its current age — the signal must see a stall
+        while it is happening, not after tokens finally flow — plus
+        per-replica token backlog and the overload shed rate since the
+        last observation."""
+        now = time.monotonic()
+        ttfts = sorted((fr.first_token_time or now) - fr.arrival
+                       for fr in self._requests.values()
+                       if fr.priority > 0 and not fr.done)
+        p95 = (ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))]
+               if ttfts else 0.0)
+        live = [rep for _, rep in self.pool_members() if not rep.broken]
+        backlog = sum(rep.scheduler.backlog_tokens() for rep in live)
+        prev_shed, prev_t = self._last_shed_obs
+        dt = max(now - prev_t, 1e-6)
+        shed_rate = (self.metrics.shed_total - prev_shed) / dt
+        self._last_shed_obs = (self.metrics.shed_total, now)
+        return {
+            "p95_ttft_interactive_s": p95,
+            "queue_per_replica": backlog / max(len(live), 1),
+            "shed_per_s": shed_rate,
+        }
+
     def _autoscale(self) -> None:
         router, _ = self._scaled_pool()
         n = len(router.replicas)
@@ -1061,52 +1115,145 @@ class ServingFleet:
         if target != n:
             self.set_replica_count(target)
 
-    def set_replica_count(self, target: int) -> None:
+    def set_replica_count(self, target: int, *,
+                          drain_deadline_s: Optional[float] = None) -> None:
         """Resize the elastic pool to ``target`` replicas.  Scale-up
-        spawns fresh replicas from the factory; scale-down drains the
-        lightest replicas with handoff — their in-flight requests migrate
-        to the survivors."""
+        spawns fresh replicas from the factory, gated by the scale
+        breaker and the fleet restart budget (a flapping autoscale
+        signal or a failing image cannot churn the fleet); scale-down is
+        graceful by construction — see :meth:`_retire_replica`."""
         router, prefix = self._scaled_pool()
         n = len(router.replicas)
         if target < 1:
             raise ValueError("set_replica_count: target must be >= 1")
+        deadline = (self.scale_drain_deadline_s if drain_deadline_s is None
+                    else drain_deadline_s)
         while len(router.replicas) < target:
-            name = self._next_name(prefix)
-            rep = router.add_replica(name, self.factory(name))
-            self._install_defenses(rep)
-            self._attach_tracer(name, rep.scheduler)
-            self.metrics.record_scale(+1)
+            if not self._spawn_replica(router, prefix):
+                break       # gated/failed: retry on a later autoscale
         while len(router.replicas) > max(target, 1):
-            # broken replicas are dead capacity holding no work: always
-            # the cheapest downsize victims (their stranded requests
-            # were terminalized and replayed at death)
-            broken = [r for r in router.replicas if r.broken]
-            victim = (broken[0] if broken else
-                      min(router.replicas, key=lambda r: r.load_tokens()))
-            _, snaps = victim.scheduler.shutdown(0.0, handoff=True)
-            self._collect()            # finishes already on the victim
-            router.remove_replica(victim.name)
-            self._respawned_at.pop(victim.name, None)
-            if victim.name in self._probe:
-                # the probe loses its replica: back to the queue
-                self._suspect_queue.insert(0, self._probe.pop(victim.name))
-            for snap in snaps:
-                fr = self._requests.get(snap.uid)
-                if self.blame.is_suspect(snap.uid):
-                    if snap.uid not in self._suspect_queue:
-                        self._suspect_queue.append(snap.uid)
-                    continue
-                if fr is not None:
-                    fr.handoffs += 1
-                self.metrics.record_handoff()
-                # through the front door (in disaggregated mode a drained
-                # decode request must re-prefill on the prefill pool, not
-                # on a sibling decode replica); parks on failure
-                self._place(snap)
-            self.metrics.record_scale(-1)
+            self._retire_replica(router, deadline)
         if len(router.replicas) != n:
             logger.info(f"fleet: elastic resize {n} -> "
                         f"{len(router.replicas)} replicas")
+
+    def _spawn_replica(self, router: CacheAwareRouter,
+                       prefix: str) -> bool:
+        """One gated elastic scale-up spawn.  Returns False when the
+        scale breaker is open, the restart budget is exhausted, or the
+        factory fails (``spawn_fail``/``scale_spawn_slow`` chaos fires
+        here) — the caller stops scaling and retries on a later tick,
+        while brownout keeps absorbing the pressure."""
+        if not self.scale_breaker.allows():
+            return False
+        if self.restart_budget is not None \
+                and self.restart_budget.exhausted():
+            logger.warning(
+                "fleet: scale-up held — restart budget exhausted "
+                f"({self.restart_budget.in_window()}/"
+                f"{self.restart_budget.max_restarts} in window)")
+            return False
+        name = self._next_name(prefix)
+        t0 = time.monotonic()
+        try:
+            if chaos.fire("spawn_fail"):
+                raise ChaosInjectedError("chaos: spawn_fail armed")
+            chaos.fire("scale_spawn_slow", key=name)
+            sched = self.factory(name)
+        except Exception as e:  # noqa: BLE001 — a failed scale-up must
+            # degrade into deeper brownout, never crash the fleet tick
+            elapsed = time.monotonic() - t0
+            opened = self.scale_breaker.record_failure()
+            self.metrics.record_scale_spawn(elapsed, ok=False)
+            if opened:
+                self.metrics.record_breaker_open(f"scale:{prefix}")
+            logger.error(
+                f"fleet: elastic spawn of {name} FAILED ({e!r}) — scale "
+                f"breaker {self.scale_breaker.state.value}, failures "
+                f"{self.scale_breaker.failures}")
+            return False
+        elapsed = time.monotonic() - t0
+        rep = router.add_replica(name, sched)
+        self._install_defenses(rep)
+        self._attach_tracer(name, sched)
+        self.scale_breaker.record_success()
+        if self.restart_budget is not None:
+            self.restart_budget.record()
+        self._respawned_at[name] = time.monotonic()
+        if self.brownout is not None:
+            # a fresh replica joins at the fleet's CURRENT degradation
+            # stage, not at full quality
+            self.brownout.apply_current([sched])
+        self.metrics.record_scale(+1)
+        self.metrics.record_scale_spawn(elapsed, ok=True)
+        self.tracer.instant("fleet/scale_up", tid="fleet",
+                            attrs={"replica": name,
+                                   "spawn_s": round(elapsed, 4)})
+        return True
+
+    def _retire_replica(self, router: CacheAwareRouter,
+                        drain_deadline_s: float) -> None:
+        """Graceful scale-down of one replica: pick the victim (broken
+        first — dead capacity holds no work — else lightest), close its
+        admission so the router stops placing on it, pump ITS scheduler
+        until its in-flight work finishes or the drain deadline expires
+        (``drain_stall`` chaos fires per drain step), then detach
+        whatever is left as handoff snapshots and migrate them to the
+        survivors.  A healthy downsize therefore replays nothing."""
+        broken = [r for r in router.replicas if r.broken]
+        victim = (broken[0] if broken else
+                  min(router.replicas, key=lambda r: r.load_tokens()))
+        sched = victim.scheduler
+        t0 = time.monotonic()
+        escalated = False
+        if not victim.broken and drain_deadline_s > 0:
+            sched.close_admission()
+            end = t0 + drain_deadline_s
+            while sched.num_pending and time.monotonic() < end:
+                if chaos.fire("drain_stall", key=victim.name):
+                    continue    # the victim makes no progress this step
+                try:
+                    sched.step()
+                except Exception as e:  # noqa: BLE001 — a drain-time
+                    # crash falls through to handoff/replay below
+                    logger.warning(f"fleet: drain of {victim.name} died "
+                                   f"({e!r}) — escalating to handoff")
+                    break
+                self._collect()     # stream finishes out as they land
+            escalated = bool(sched.num_pending)
+        _, snaps = sched.shutdown(0.0, handoff=True)
+        self._collect()            # finishes already on the victim
+        elapsed = time.monotonic() - t0
+        router.remove_replica(victim.name)
+        self._respawned_at.pop(victim.name, None)
+        if victim.name in self._probe:
+            # the probe loses its replica: back to the queue
+            self._suspect_queue.insert(0, self._probe.pop(victim.name))
+        for snap in snaps:
+            fr = self._requests.get(snap.uid)
+            if self.blame.is_suspect(snap.uid):
+                if snap.uid not in self._suspect_queue:
+                    self._suspect_queue.append(snap.uid)
+                continue
+            if fr is not None:
+                fr.handoffs += 1
+            self.metrics.record_handoff()
+            # through the front door (in disaggregated mode a drained
+            # decode request must re-prefill on the prefill pool, not
+            # on a sibling decode replica); parks on failure
+            self._place(snap)
+        self.metrics.record_scale(-1)
+        self.metrics.record_scale_drain(elapsed, escalated)
+        self.tracer.instant("fleet/scale_down", tid="fleet",
+                            attrs={"replica": victim.name,
+                                   "drain_s": round(elapsed, 4),
+                                   "escalated": escalated,
+                                   "handoffs": len(snaps)})
+        if escalated:
+            logger.warning(
+                f"fleet: downsize drain of {victim.name} escalated at "
+                f"deadline ({drain_deadline_s}s) — {len(snaps)} "
+                "request(s) handed off")
 
     # ------------------------------------------------------------------ #
     # Telemetry

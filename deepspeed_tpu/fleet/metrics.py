@@ -32,12 +32,15 @@ def _declare(reg: MetricsRegistry) -> None:
               "breaker_opens", "breaker_closes", "shed_total",
               "requests", "requests_finished", "requests_failed",
               "submitted", "finished", "failed", "preemptions",
-              "total_tokens"):
+              "total_tokens", "brownout_transitions", "brownout_held",
+              "scale_spawn_failed", "scale_drain_escalations"):
         reg.counter(f"fleet/{n}")
     for n in ("requests_live", "replicas", "replicas_broken",
               "breakers_open", "suspects_pending",
               "goodput_tokens_per_s", "spec_accept_rate",
-              "p50_handoff_s", "p95_handoff_s"):
+              "p50_handoff_s", "p95_handoff_s",
+              "brownout_stage", "brownout_pressure",
+              "scale_up_spawn_s", "scale_down_drain_s"):
         reg.gauge(f"fleet/{n}")
     # derived families: per-class sheds, per-reason deaths, per-pool
     # replica/queue gauges, speculative rollup, and the router snapshot
@@ -48,6 +51,9 @@ def _declare(reg: MetricsRegistry) -> None:
     reg.gauge("fleet/pending_*", help="pending requests per pool")
     reg.gauge("fleet/spec_*", help="speculative decoding rollup")
     reg.gauge("fleet/router_*", help="router placement/admission rollup")
+    reg.counter("fleet/brownout_*",
+                help="degradation-ladder stage entries/exits")
+    reg.gauge("fleet/scale_*", help="elastic scale-event rollup")
 
 
 _declare(MetricsRegistry.default())
@@ -76,6 +82,13 @@ class FleetMetrics:
         self.shed_total = 0          # overload backpressure sheds
         self.shed_by_class: Dict[str, int] = {}
         self.deaths_by_reason: Dict[str, int] = {}
+        # -- elastic capacity / brownout -------------------------------- #
+        self.brownout_stage = 0      # current degradation-ladder stage
+        self.brownout_by_stage: Dict[str, int] = {}  # enter/exit counters
+        self.scale_spawn_failed = 0  # scale-up spawns that failed
+        self.scale_drain_escalations = 0  # drains past deadline
+        self.scale_spawn_s: Optional[float] = None   # last spawn latency
+        self.scale_drain_s: Optional[float] = None   # last drain latency
         #: bounded: a long-running fleet must not grow host memory per
         #: handoff — percentiles are over the most recent window
         self.handoff_latency_s: Deque[float] = deque(maxlen=1024)
@@ -125,6 +138,32 @@ class FleetMetrics:
         self.shed_by_class[priority_class] = \
             self.shed_by_class.get(priority_class, 0) + 1
 
+    # -- elastic capacity / brownout hooks ------------------------------ #
+    def record_brownout(self, stage: int) -> None:
+        """The brownout ladder moved to ``stage`` (always one step from
+        the last recorded stage) — keeps the stage gauge plus per-stage
+        enter/exit counters."""
+        if stage > self.brownout_stage:
+            key = f"brownout_enter_stage{stage}"
+        else:
+            key = f"brownout_exit_stage{self.brownout_stage}"
+        self.brownout_by_stage[key] = self.brownout_by_stage.get(key, 0) + 1
+        self.brownout_stage = stage
+
+    def record_scale_spawn(self, latency_s: float, ok: bool) -> None:
+        """One elastic scale-up spawn attempt (success or failure)."""
+        self.scale_spawn_s = latency_s
+        if not ok:
+            self.scale_spawn_failed += 1
+
+    def record_scale_drain(self, latency_s: float,
+                           escalated: bool) -> None:
+        """One scale-down victim drained (``escalated`` = the drain
+        deadline expired and leftovers were detached/replayed)."""
+        self.scale_drain_s = latency_s
+        if escalated:
+            self.scale_drain_escalations += 1
+
     def record_death(self, reason: str) -> None:
         """One replica incarnation death, by cause (``killed`` | ``crash``
         | ``tick_stall`` | ...) — slow-but-returning ticks (the watchdog's
@@ -158,12 +197,25 @@ class FleetMetrics:
             out[f"fleet/shed_{cls}"] = float(n)
         for reason, n in self.deaths_by_reason.items():
             out[f"fleet/deaths_{reason}"] = float(n)
+        out["fleet/brownout_stage"] = float(self.brownout_stage)
+        for key, n in self.brownout_by_stage.items():
+            out[f"fleet/{key}"] = float(n)
+        out["fleet/scale_spawn_failed"] = float(self.scale_spawn_failed)
+        out["fleet/scale_drain_escalations"] = \
+            float(self.scale_drain_escalations)
+        if self.scale_spawn_s is not None:
+            out["fleet/scale_up_spawn_s"] = float(self.scale_spawn_s)
+        if self.scale_drain_s is not None:
+            out["fleet/scale_down_drain_s"] = float(self.scale_drain_s)
         if self.handoff_latency_s:
             lat = np.asarray(list(self.handoff_latency_s), np.float64)
             out["fleet/p50_handoff_s"] = float(np.percentile(lat, 50))
             out["fleet/p95_handoff_s"] = float(np.percentile(lat, 95))
         if fleet is None:
             return out
+        brownout = getattr(fleet, "brownout", None)
+        if brownout is not None:
+            out.update(brownout.telemetry())
         # client-level request accounting (a handed-off request counts
         # once here, however many schedulers it visited)
         frs = fleet.requests

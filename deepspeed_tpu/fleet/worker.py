@@ -57,6 +57,7 @@ from deepspeed_tpu.resilience import heartbeat as hb
 from deepspeed_tpu.resilience.supervisor import (BackoffPolicy,
                                                  JobSupervisor, WorkerSpec)
 from deepspeed_tpu.serving.request import RequestSnapshot, SamplingParams
+from deepspeed_tpu.serving.router import DEFAULT_PRIORITY_CLASSES
 from deepspeed_tpu.utils.logging import logger
 
 STOP_FILE = "stop"
@@ -229,33 +230,141 @@ class FleetFrontEnd:
         self._offsets: Dict[tuple, int] = {}
         self.spools: Dict[str, str] = {}
         self.supervisors: Dict[str, JobSupervisor] = {}
+        #: workers mid graceful retirement: the stop file is down, the
+        #: drain is running — no new dispatches land there
+        self._retiring: set = set()
+        #: elastic lifecycle accounting (mirrors the in-process fleet's
+        #: fleet/scale_* telemetry)
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.drain_escalations = 0
+        # everything _make_worker needs at add_worker time
+        self._worker_argv_fn = worker_argv_fn
+        self._env = dict(env or {})
+        self._sup_kwargs = dict(
+            heartbeat_interval_s=heartbeat_interval_s,
+            hang_timeout_s=hang_timeout_s,
+            startup_timeout_s=startup_timeout_s,
+            max_restarts=max_restarts,
+            restart_window_s=restart_window_s,
+            backoff=backoff or BackoffPolicy(base_s=0.2, jitter=0.1),
+            blacklist_after=max_restarts + 1,  # one host: never shrink
+            min_hosts=1)
+        self._worker_counter = itertools.count(n_replicas)
         for i in range(n_replicas):
-            name = f"replica{i}"
-            spool = os.path.join(run_dir, name)
-            os.makedirs(os.path.join(spool, INBOX_DIR), exist_ok=True)
-            self.spools[name] = spool
-            argv = worker_argv_fn(name, spool)
-
-            def spec_fn(hosts, attempt, _argv=argv, _name=name,
-                        _env=dict(env or {})):
-                env_ = dict(_env)
-                env_[ENV_INCARNATION] = str(attempt)
-                return [WorkerSpec(host=_name, cmd=list(_argv), env=env_)]
-
-            self.supervisors[name] = JobSupervisor(
-                spec_fn, [name],
-                run_dir=os.path.join(spool, "supervisor"),
-                heartbeat_interval_s=heartbeat_interval_s,
-                hang_timeout_s=hang_timeout_s,
-                startup_timeout_s=startup_timeout_s,
-                max_restarts=max_restarts,
-                restart_window_s=restart_window_s,
-                backoff=backoff or BackoffPolicy(base_s=0.2, jitter=0.1),
-                blacklist_after=max_restarts + 1,  # one host: never shrink
-                min_hosts=1)
-            self.restarts_seen[name] = 0
+            self._make_worker(f"replica{i}")
         for sup in self.supervisors.values():
             sup.start()
+
+    def _make_worker(self, name: str) -> JobSupervisor:
+        """Wire one replica worker (spool dir, inbox, supervisor) without
+        starting it — the constructor batch-starts; ``add_worker`` starts
+        its own."""
+        spool = os.path.join(self.run_dir, name)
+        os.makedirs(os.path.join(spool, INBOX_DIR), exist_ok=True)
+        self.spools[name] = spool
+        argv = self._worker_argv_fn(name, spool)
+
+        def spec_fn(hosts, attempt, _argv=argv, _name=name,
+                    _env=dict(self._env)):
+            env_ = dict(_env)
+            env_[ENV_INCARNATION] = str(attempt)
+            return [WorkerSpec(host=_name, cmd=list(_argv), env=env_)]
+
+        sup = JobSupervisor(spec_fn, [name],
+                            run_dir=os.path.join(spool, "supervisor"),
+                            **self._sup_kwargs)
+        self.supervisors[name] = sup
+        self.restarts_seen[name] = 0
+        return sup
+
+    # -- elastic worker lifecycle ---------------------------------------- #
+    def add_worker(self, name: Optional[str] = None,
+                   warmup_timeout_s: float = 120.0) -> str:
+        """Spawn one more supervised replica worker and wait (bounded)
+        for its first heartbeat, so the caller knows real capacity
+        arrived before routing to it.  The ``scale_spawn_slow`` chaos
+        point fires here — a delayed first beat must slow THIS call
+        down, not trick the caller into spawning twice."""
+        if name is None:
+            name = f"replica{next(self._worker_counter)}"
+        if name in self.spools:
+            raise ValueError(f"add_worker: worker {name!r} already exists")
+        from deepspeed_tpu.resilience import chaos
+        chaos.fire("scale_spawn_slow", key=name)
+        sup = self._make_worker(name)
+        sup.start()
+        deadline = time.monotonic() + warmup_timeout_s
+        while time.monotonic() < deadline:
+            handles = getattr(sup, "handles", None) or []
+            if any(h.beat_age() is not None for h in handles):
+                break
+            if sup.returncode is not None:
+                break        # supervisor gave up; _check_restarts raises
+            time.sleep(0.02)
+        self.scale_ups += 1
+        logger.info(f"fleet front-end: scale-up spawned worker {name}")
+        return name
+
+    def remove_worker(self, name: str,
+                      drain_deadline_s: float = 15.0) -> int:
+        """Gracefully retire one worker: take it out of dispatch, drop
+        the stop file (the worker drains in place and exits 0), keep
+        polling so its final tokens stream out, then migrate whatever it
+        could not finish to the survivors.  A worker that never finishes
+        draining (``drain_stall``, SIGKILL mid-drain) is escalated at
+        the deadline: the supervisor tears it down and the journal
+        replays its leftovers — zero requests lost either way.  Returns
+        the number of requests migrated/replayed off the victim."""
+        if name not in self.spools:
+            raise ValueError(f"remove_worker: unknown worker {name!r}")
+        if len(self.spools) - len(self._retiring) <= 1:
+            raise ValueError("remove_worker: cannot retire the last "
+                             "routable worker")
+        sup = self.supervisors[name]
+        self._retiring.add(name)
+        with open(os.path.join(self.spools[name], STOP_FILE), "w") as f:
+            f.write("stop")
+        deadline = time.monotonic() + drain_deadline_s
+        while time.monotonic() < deadline and sup.returncode is None:
+            # the poll ingests drain-finish events AND lets
+            # _check_restarts journal-replay a SIGKILLed victim
+            self.poll()
+            if sup.returncode is None:
+                time.sleep(0.02)
+        escalated = sup.returncode is None
+        if escalated:
+            self.drain_escalations += 1
+            logger.warning(
+                f"fleet front-end: worker {name} drain deadline "
+                f"({drain_deadline_s}s) expired — escalating to "
+                "supervisor teardown + journal replay")
+        sup.stop()
+        # every incarnation's journal is final now: recover all flushed
+        # tokens/finishes before building migration snapshots
+        for old in range(self.restarts_seen[name], sup.attempt + 1):
+            self._drain_events(name, attempt=old, final=True)
+        leftovers = [fr for fr in self.requests.values()
+                     if not fr.done and self._home.get(fr.uid) == name]
+        for fr in leftovers:
+            if escalated:
+                fr.replays += 1
+                self.replays += 1
+            else:
+                fr.handoffs += 1
+            self._dispatch(fr)
+        probe_uid = self._isolating.pop(name, None)
+        if probe_uid is not None and probe_uid not in self._suspect_queue:
+            self._suspect_queue.insert(0, probe_uid)
+        del self.supervisors[name]
+        del self.spools[name]
+        self.restarts_seen.pop(name, None)
+        self._outstanding_by.pop(name, None)
+        self._retiring.discard(name)
+        self.scale_downs += 1
+        logger.info(f"fleet front-end: worker {name} retired "
+                    f"({len(leftovers)} migrated, escalated={escalated})")
+        return len(leftovers)
 
     # -- submission ----------------------------------------------------- #
     def _outstanding(self, name: str) -> int:
@@ -284,9 +393,11 @@ class FleetFrontEnd:
 
     def _dispatch(self, fr: FleetRequest) -> None:
         """Route ``fr`` to the least-outstanding replica that is NOT
-        isolating a poison suspect; with none routable (every replica
-        probing), park it — retried each poll, never dropped."""
-        names = [n for n in self.spools if n not in self._isolating]
+        isolating a poison suspect and NOT retiring; with none routable
+        (every replica probing), park it — retried each poll, never
+        dropped."""
+        names = [n for n in self.spools
+                 if n not in self._isolating and n not in self._retiring]
         if not names:
             # detach the outstanding charge BEFORE parking: a stale
             # count on a reserved worker would gate _pump_isolation's
@@ -303,11 +414,40 @@ class FleetFrontEnd:
         self._write_snapshot(target, fr.snapshot())
 
     def submit(self, prompt, sampling: Optional[SamplingParams] = None,
-               tenant: str = "default") -> FleetRequest:
+               tenant: str = "default", *,
+               priority_class: Optional[str] = None,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               on_token=None,
+               trace_id: Optional[str] = None) -> FleetRequest:
+        """Journal + dispatch one request.  ``priority`` /
+        ``deadline_s`` ride the spool protocol: the FleetRequest
+        snapshot serializes both into the inbox record, and the worker's
+        ``resubmit`` rebuilds a deadline-scheduled, priority-ordered
+        Request from them — a deadline can expire ON the subprocess
+        worker and journal back as a typed ``deadline`` failure.
+        ``priority_class`` maps through the router's named classes
+        (interactive/standard/batch) when no explicit ``priority`` is
+        given."""
+        if priority is None:
+            if priority_class is not None:
+                cls = DEFAULT_PRIORITY_CLASSES.get(priority_class)
+                if cls is None:
+                    raise ValueError(
+                        f"submit: unknown priority class "
+                        f"{priority_class!r} "
+                        f"(have {sorted(DEFAULT_PRIORITY_CLASSES)})")
+                priority = cls.priority
+                if deadline_s is None:
+                    deadline_s = cls.deadline_s
+            else:
+                priority = 0
         uid = next(self._uid_counter)
         fr = FleetRequest(uid=uid, prompt=[int(t) for t in prompt],
                           sampling=sampling or SamplingParams(),
-                          tenant=tenant, trace_id=mint_trace_id())
+                          tenant=tenant, priority=priority,
+                          deadline_s=deadline_s, on_token=on_token,
+                          trace_id=trace_id or mint_trace_id())
         self.requests[uid] = fr
         self._n_live += 1
         self._dispatch(fr)
@@ -391,9 +531,10 @@ class FleetFrontEnd:
                 if fr.on_token is not None:
                     fr.on_token(fr, int(rec["tok"]))
             elif "done" in rec:
-                if rec["done"] == "rejected" \
+                if rec["done"] in ("rejected", "shutdown") \
                         and fr.replays < self.max_replays:
-                    # admission rejection (queue burst, draining worker):
+                    # admission rejection (queue burst, draining worker)
+                    # or a retiring worker's drain-deadline leftover:
                     # bounce to another replica instead of failing — a
                     # bounded number of times, so a truly unservable
                     # request still terminates.  A rejected ISOLATION
@@ -407,8 +548,12 @@ class FleetFrontEnd:
                             self._suspect_queue.append(fr.uid)
                         self._move(fr, None)
                         continue
-                    fr.replays += 1
-                    self.replays += 1
+                    if rec["done"] == "shutdown":
+                        # a planned drain migration, not a crash replay
+                        fr.handoffs += 1
+                    else:
+                        fr.replays += 1
+                        self.replays += 1
                     self._dispatch(fr)
                     continue
                 fr.state = ("finished" if rec.get("state") == "finished"
@@ -574,6 +719,12 @@ class FleetFrontEnd:
     @property
     def num_pending(self) -> int:
         return self._n_live
+
+    def step(self) -> None:
+        """Fleet-shaped alias for the gateway pump / replay harness: one
+        front-end poll (the actual scheduler ticks happen inside the
+        worker subprocesses)."""
+        self.poll()
 
     def poll(self) -> None:
         for name in self.spools:

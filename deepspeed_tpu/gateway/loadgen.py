@@ -230,7 +230,8 @@ def _session_prompt(r: TraceRequest, vocab: int,
 
 def replay(trace: RequestTrace, backend, *, speed: float = 1.0,
            vocab: int = 512, greedy: bool = True,
-           max_wall_s: float = 120.0, drain: bool = True) -> dict:
+           max_wall_s: float = 120.0, drain: bool = True,
+           on_tick=None) -> dict:
     """Open-loop replay: each request submits at ``offset_s / speed``
     wall seconds after start, regardless of how the fleet is doing —
     overload therefore lands on the admission machinery, not on a
@@ -239,6 +240,11 @@ def replay(trace: RequestTrace, backend, *, speed: float = 1.0,
     ``backend`` is fleet-shaped (``submit``/``step``/``num_pending``);
     kwargs its ``submit`` does not take (priority_class on a
     FleetFrontEnd) degrade away instead of crashing.
+
+    ``on_tick(elapsed_s)``, called once per replay loop iteration, is
+    the scale-event scenario hook: an elastic soak samples fleet size /
+    brownout stage against the trace's diurnal phase here (and may even
+    force scale events) without the harness knowing fleet internals.
     """
     try:
         accepted = frozenset(inspect.signature(backend.submit).parameters)
@@ -255,6 +261,8 @@ def replay(trace: RequestTrace, backend, *, speed: float = 1.0,
         now = time.monotonic() - t0
         if now > max_wall_s:
             break
+        if on_tick is not None:
+            on_tick(now)
         while pending and pending[0].offset_s / speed <= now:
             r = pending.pop(0)
             kw = {"tenant": r.tenant, "priority_class": r.priority_class,

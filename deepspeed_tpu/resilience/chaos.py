@@ -57,7 +57,25 @@ quarantine + circuit-breaker + watchdog layer in
 ``spawn_fail``
     in :meth:`ServingFleet._respawn` before the scheduler factory runs
     (default: ``raise`` — a replica whose respawn keeps failing must
-    open its circuit breaker instead of eating restart budget).
+    open its circuit breaker instead of eating restart budget).  Also
+    fired by the elastic scale-up path (:meth:`ServingFleet.
+    set_replica_count`): a failed spawn under load must deepen brownout,
+    not crash the fleet.
+
+Elastic-capacity fault points (the scale-event failure modes
+:meth:`ServingFleet.set_replica_count` and the autoscaler exist to
+survive):
+
+``drain_stall``
+    inside the scale-down victim's graceful drain loop, fired per drain
+    step with ``key=<replica name>`` (default: ``sleep`` — the victim
+    stops finishing work; the fleet must escalate to handoff/replay
+    teardown at the drain deadline instead of waiting forever).
+``scale_spawn_slow``
+    before a scale-up spawn completes — in-process before the factory
+    returns, subprocess before the worker's first beat (default:
+    ``sleep`` — a slow-arriving replica; the autoscaler must not
+    double-spawn while the first spawn is still warming).
 
 Actions: ``crash`` (``os._exit``, for subprocess kill tests), ``raise``
 (:class:`ChaosInjectedError`, for in-process tests), ``corrupt`` (flip one
@@ -100,6 +118,8 @@ FAULT_POINTS: Dict[str, str] = {
     "poison_request": "raise",
     "tick_stall": "sleep",
     "spawn_fail": "raise",
+    "drain_stall": "sleep",
+    "scale_spawn_slow": "sleep",
 }
 
 ENV_VAR = "DS_CHAOS"

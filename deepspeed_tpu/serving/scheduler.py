@@ -147,6 +147,14 @@ class ContinuousBatchScheduler:
         #: prescribes (both dropped when the request terminalizes)
         self._spec_accept_ewma: Dict[int, float] = {}
         self._spec_k: Dict[int, int] = {}
+        #: runtime degradation knobs (fleet/brownout.py): a draft-K cap
+        #: that squeezes speculation without touching config, a master
+        #: speculative enable, and tightened admission caps — all
+        #: reversible through the set_* setters below
+        self.spec_k_cap: Optional[int] = None
+        self._speculative_enabled = True
+        self.admit_max_new_tokens: Optional[int] = None
+        self.admit_max_context: Optional[int] = None
         #: pure-decode ticks go through ``engine.decode_step`` — block
         #: tables/positions stay device-resident across ticks and the
         #: only host transfer is the sampled-token fetch, instead of a
@@ -157,6 +165,8 @@ class ContinuousBatchScheduler:
         self.fast_ticks = 0
         sm_cfg = engine.config.state_manager
         self.token_budget = sm_cfg.max_ragged_batch_size
+        #: the configured budget, for set_token_budget(None) to restore
+        self._base_token_budget = self.token_budget
         self.max_seqs = sm_cfg.max_ragged_sequence_count
         self.max_context = sm_cfg.max_context
         self.metrics = metrics if metrics is not None \
@@ -195,6 +205,52 @@ class ContinuousBatchScheduler:
         #: liveness ticker for the job supervisor's hang detector (one
         #: beat per scheduler tick; a wedged engine forward goes stale)
         self._heartbeat = Heartbeat.from_env()
+
+    # ------------------------------------------------------------------ #
+    # Runtime degradation knobs (brownout)
+    # ------------------------------------------------------------------ #
+    @property
+    def _spec_active(self):
+        """The speculative config when speculation is enabled right now
+        (brownout stage 3 flips the enable without losing the config)."""
+        return self.speculative if self._speculative_enabled else None
+
+    def set_speculative_enabled(self, enabled: bool) -> None:
+        """Disable/re-enable speculative decoding at runtime.  A no-op
+        on schedulers built without a speculative config."""
+        self._speculative_enabled = bool(enabled)
+
+    def set_spec_k_cap(self, cap: Optional[int]) -> None:
+        """Cap the effective draft K below the configured ``draft_k``
+        (None restores).  Shrinks the verify lookahead immediately —
+        the pass's gamma follows the longest draft actually proposed."""
+        if cap is not None and cap < 1:
+            raise ValueError("spec_k_cap must be >= 1 (or None)")
+        self.spec_k_cap = cap
+
+    def set_token_budget(self, budget: Optional[int]) -> None:
+        """Cap the per-tick prefill token budget (None restores the
+        configured ``max_ragged_batch_size``).  Caps only — the budget
+        never rises above the compiled batch geometry."""
+        if budget is None:
+            self.token_budget = self._base_token_budget
+        elif budget < 1:
+            raise ValueError("token_budget must be >= 1 (or None)")
+        else:
+            self.token_budget = min(budget, self._base_token_budget)
+
+    def set_admission_caps(self, max_new_tokens: Optional[int] = None,
+                           max_context: Optional[int] = None) -> None:
+        """Tighten admission at runtime: clamp each new request's
+        ``max_new_tokens`` and reject prompts longer than the tightened
+        context cap with a retryable :class:`QueueFullError` (None/None
+        restores).  Already-admitted requests are untouched."""
+        if max_new_tokens is not None and max_new_tokens < 1:
+            raise ValueError("admit_max_new_tokens must be >= 1 (or None)")
+        if max_context is not None and max_context < 2:
+            raise ValueError("admit_max_context must be >= 2 (or None)")
+        self.admit_max_new_tokens = max_new_tokens
+        self.admit_max_context = max_context
 
     # ------------------------------------------------------------------ #
     # Submission
@@ -242,6 +298,21 @@ class ContinuousBatchScheduler:
                 f"submit: admission queue full ({len(self._queued)} waiting, "
                 f"max_queue={self.max_queue}) — request {request.uid} "
                 "rejected; retry after the queue drains")
+        # brownout stage-4 admission tightening: clamp the generation
+        # budget (shorter answers, not failures) and shed over-long
+        # prompts with a retryable error instead of a permanent one
+        if self.admit_max_new_tokens is not None \
+                and request.sampling.max_new_tokens \
+                > self.admit_max_new_tokens:
+            request.sampling.max_new_tokens = self.admit_max_new_tokens
+        if self.admit_max_context is not None \
+                and len(request.history) + 1 > self.admit_max_context:
+            self.metrics.record_reject(request)
+            raise QueueFullError(
+                f"submit: history of {len(request.history)} tokens exceeds "
+                f"the brownout-tightened context cap "
+                f"{self.admit_max_context} — request {request.uid} "
+                "rejected; retry when pressure recedes")
         # history, not prompt: a resubmitted (handed-off) request carries
         # already-generated tokens that need KV room too
         if len(request.history) + 1 > self.max_context:
@@ -426,12 +497,12 @@ class ContinuousBatchScheduler:
         with step_annotation(self._tick):
             if self.fast_decode and decode_tick:
                 emitted = None
-                if self.speculative is not None:
+                if self._spec_active is not None:
                     with self._phase("verify", tick_h):
                         emitted = self._speculative_decode_tick(
                             uids, chunks, packed)
                 if emitted is None:
-                    if self.speculative is not None:
+                    if self._spec_active is not None:
                         self.spec_stats.fallback_ticks += 1
                     with self._phase("decode", tick_h):
                         emitted = self._fast_decode_tick(uids, chunks,
@@ -517,6 +588,8 @@ class ContinuousBatchScheduler:
             # draft_k is the cap, so program shapes stay bounded
             k_r = (self._spec_k.get(r.uid, spec.draft_k)
                    if spec.autotune_k else spec.draft_k)
+            if self.spec_k_cap is not None:
+                k_r = max(1, min(k_r, self.spec_k_cap))
             k_targets.append(k_r)
             # never draft past the generation budget: at most
             # remaining - 1 drafts can be emitted alongside the bonus
@@ -528,8 +601,9 @@ class ContinuousBatchScheduler:
             return None
         # the pass's K covers the longest draft actually proposed — an
         # all-shrunk batch runs a genuinely smaller verify program
-        gamma = max(len(d) for d in drafts) if spec.autotune_k \
-            else spec.draft_k
+        gamma = (max(len(d) for d in drafts)
+                 if spec.autotune_k or self.spec_k_cap is not None
+                 else spec.draft_k)
         K = gamma + 1
         if not self.engine.can_schedule(uids, [K] * len(uids)):
             return None                  # lookahead KV/context won't fit
@@ -991,6 +1065,16 @@ class ContinuousBatchScheduler:
                 self._fail(req, "shutdown")
             self._export_metrics()
         return idle
+
+    def close_admission(self) -> None:
+        """Close admission WITHOUT draining: ``submit`` raises from now
+        on and routers skip this replica, but in-flight work keeps
+        stepping under the caller's control.  The fleet's graceful
+        scale-down uses this to quiesce a victim while it keeps pumping
+        the victim's scheduler (and chaos-injecting its drain) itself,
+        then calls :meth:`shutdown(0, handoff=True)` to detach whatever
+        is left."""
+        self._shutting_down = True
 
     # ------------------------------------------------------------------ #
     # Cross-replica handoff (the fleet layer's migration primitive)

@@ -1,0 +1,297 @@
+"""Elastic capacity + brownout ladder: hysteresis (an oscillating signal
+must NOT flap the fleet), strict one-step/reverse-order stage walking
+with every knob restored on the way down, transition budget holds, every
+transition traced + metered, the elastic chaos points (``drain_stall`` /
+``scale_spawn_slow``) incl. their ``DS_CHAOS`` env forms, the metric-name
+lint over the new ``fleet/brownout_*`` / ``fleet/scale_*`` families, and
+the tier-1 elastic soak (``tools/elastic_smoke.py``) behind a hard
+timeout.
+
+Everything above the smoke is pure-host (no engine, no JAX device work):
+the BrownoutController is deliberately fleet-agnostic, so these tests
+drive it with synthetic signal series and knob-recording scheduler
+fakes.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from deepspeed_tpu.fleet import (AdmissionBudget, BrownoutController,
+                                 FleetMetrics)
+from deepspeed_tpu.fleet.brownout import NUM_STAGES
+from deepspeed_tpu.observability.tracer import Tracer
+from deepspeed_tpu.resilience import chaos
+
+_TOOL = pathlib.Path(__file__).resolve().parents[2] / "tools" / \
+    "elastic_smoke.py"
+
+#: pressure >> 1 on the queue signal alone (others stay at zero)
+HOT = {"queue_per_replica": 1e6}
+#: pressure == 0 everywhere
+COOL = {}
+
+
+def _band(ctrl):
+    """A signal inside the hysteresis band: above the exit bar, below
+    the enter bar — it must reset BOTH dwell counters."""
+    return {"queue_per_replica":
+            ctrl.queue_high * (ctrl.exit_fraction + 1.0) / 2.0}
+
+
+class _KnobSched:
+    """Records the brownout scheduler-knob calls in order."""
+
+    def __init__(self):
+        self._base_token_budget = 64
+        self.calls = []
+
+    def set_spec_k_cap(self, v):
+        self.calls.append(("spec_k", v))
+
+    def set_speculative_enabled(self, v):
+        self.calls.append(("spec_on", v))
+
+    def set_token_budget(self, v):
+        self.calls.append(("budget", v))
+
+    def set_admission_caps(self, a, b):
+        self.calls.append(("caps", a, b))
+
+
+# --------------------------------------------------------------------- #
+# Ladder mechanics
+# --------------------------------------------------------------------- #
+def test_ladder_climbs_one_step_and_disengages_in_reverse():
+    ctrl = BrownoutController(enter_patience=1, exit_patience=1,
+                              max_transitions=40)
+    adm = AdmissionBudget(max_backlog_tokens=100.0)
+    ctrl.attach(admission=adm)
+    s = _KnobSched()
+    batch0, std0 = adm.ceiling("batch"), adm.ceiling("standard")
+    t = 0.0
+    for expect in range(1, NUM_STAGES + 1):   # one step per observation
+        t += 1.0
+        assert ctrl.observe(HOT, [s], now=t) == expect
+    t += 1.0
+    assert ctrl.observe(HOT, [s], now=t) == NUM_STAGES   # capped
+    assert adm.ceiling("batch") == ctrl.batch_ceiling
+    assert adm.ceiling("standard") == ctrl.standard_ceiling
+    enters = list(s.calls)
+    assert enters == [("spec_k", ctrl.spec_k_cap),          # stage 2
+                      ("spec_on", False), ("budget", 32),   # stage 3
+                      ("caps", ctrl.max_new_tokens_cap, None)]  # stage 4
+    for expect in range(NUM_STAGES - 1, -1, -1):  # strict reverse order
+        t += 1.0
+        assert ctrl.observe(COOL, [s], now=t) == expect
+    # every ceiling and scheduler knob restored, mirror-ordered
+    assert adm.ceiling("batch") == batch0
+    assert adm.ceiling("standard") == std0
+    assert s.calls[len(enters):] == [
+        ("caps", None, None),                   # stage 4 exit
+        ("spec_on", True), ("budget", None),    # stage 3 exit
+        ("spec_k", None)]                       # stage 2 exit
+    assert ctrl.transitions == 2 * NUM_STAGES
+
+
+def test_oscillating_signal_does_not_flap():
+    ctrl = BrownoutController(enter_patience=2, exit_patience=2,
+                              max_transitions=40)
+    t = 0.0
+    # hot/band alternation: the band resets both dwell counters, so the
+    # enter patience is never accumulated
+    for i in range(40):
+        t += 1.0
+        ctrl.observe(HOT if i % 2 == 0 else _band(ctrl), now=t)
+    assert ctrl.stage == 0 and ctrl.transitions == 0
+    # hot/cool alternation: each flips the other's counter back to zero
+    for i in range(40):
+        t += 1.0
+        ctrl.observe(HOT if i % 2 == 0 else COOL, now=t)
+    assert ctrl.stage == 0 and ctrl.transitions == 0
+    # sanity: the same controller DOES move once the signal is a trend
+    for _ in range(2):
+        t += 1.0
+        ctrl.observe(HOT, now=t)
+    assert ctrl.stage == 1
+
+
+def test_transition_budget_holds_the_ladder():
+    ctrl = BrownoutController(enter_patience=1, exit_patience=1,
+                              max_transitions=2,
+                              transition_window_s=1000.0)
+    t = 0.0
+    for _ in range(6):
+        t += 1.0
+        ctrl.observe(HOT, now=t)
+    assert ctrl.stage == 2                 # budget stopped the climb
+    assert ctrl.transitions == 2
+    assert ctrl.held_by_budget >= 1
+
+
+def test_every_transition_is_traced_and_metered():
+    tracer = Tracer(tid="fleet")
+    metrics = FleetMetrics()
+    ctrl = BrownoutController(enter_patience=1, exit_patience=1,
+                              max_transitions=40)
+    ctrl.attach(tracer=tracer, metrics=metrics)
+    t = 0.0
+    for _ in range(3):
+        t += 1.0
+        ctrl.observe(HOT, now=t)
+    for _ in range(3):
+        t += 1.0
+        ctrl.observe(COOL, now=t)
+    evs = tracer.export_events()
+    spans = [e for e in evs if e["name"].startswith("brownout/stage")
+             and e["ph"] == "X"]
+    assert {e["name"] for e in spans} == \
+        {"brownout/stage1", "brownout/stage2", "brownout/stage3"}
+    assert all(not e["args"].get("unfinished") for e in spans), \
+        "a stage span leaked past its exit"
+    instants = [e for e in evs if e["name"] == "brownout/transition"]
+    assert len(instants) == 6              # one per move, both directions
+    # ... and every move landed a metric sample
+    assert metrics.brownout_by_stage == {
+        "brownout_enter_stage1": 1, "brownout_enter_stage2": 1,
+        "brownout_enter_stage3": 1, "brownout_exit_stage3": 1,
+        "brownout_exit_stage2": 1, "brownout_exit_stage1": 1}
+    assert metrics.brownout_stage == 0
+    snap = metrics.snapshot()
+    assert snap["fleet/brownout_enter_stage3"] == 1.0
+    assert snap["fleet/brownout_exit_stage1"] == 1.0
+    assert snap["fleet/brownout_stage"] == 0.0
+
+
+def test_apply_current_onboards_a_fresh_scheduler_degraded():
+    ctrl = BrownoutController(enter_patience=1, exit_patience=1,
+                              max_transitions=40)
+    t = 0.0
+    for _ in range(3):
+        t += 1.0
+        ctrl.observe(HOT, now=t)
+    late = _KnobSched()                    # an elastically-spawned replica
+    ctrl.apply_current([late])
+    assert late.calls == [("spec_k", ctrl.spec_k_cap),
+                          ("spec_on", False), ("budget", 32)]
+
+
+def test_brownout_rejects_bad_config():
+    with pytest.raises(ValueError, match="exit_fraction"):
+        BrownoutController(exit_fraction=1.0)
+    with pytest.raises(ValueError, match="patience"):
+        BrownoutController(enter_patience=0)
+    with pytest.raises(ValueError, match="thresholds"):
+        BrownoutController(ttft_slo_s=0.0)
+
+
+# --------------------------------------------------------------------- #
+# Elastic chaos points
+# --------------------------------------------------------------------- #
+def test_chaos_drain_stall_is_key_scoped():
+    with chaos.inject("drain_stall", "drop", key="replica1", count=0):
+        assert chaos.fire("drain_stall", key="replica1")
+        assert not chaos.fire("drain_stall", key="replica2")
+        assert not chaos.fire("drain_stall")   # keyless call, keyed fault
+        assert chaos.fire("drain_stall", key="replica1")
+    assert not chaos.fire("drain_stall", key="replica1")   # disarmed
+
+
+def test_chaos_scale_spawn_slow_default_action_sleeps():
+    assert chaos.FAULT_POINTS["scale_spawn_slow"] == "sleep"
+    assert chaos.FAULT_POINTS["drain_stall"] == "sleep"
+    with chaos.inject("scale_spawn_slow", sleep_s=0.05, count=0):
+        t0 = time.monotonic()
+        assert chaos.fire("scale_spawn_slow", key="replica7")
+        assert time.monotonic() - t0 >= 0.04
+
+
+def test_chaos_env_arms_elastic_points(monkeypatch):
+    monkeypatch.setenv(
+        "DS_CHAOS",
+        "drain_stall:action=drop,key=replica0,count=0;"
+        "scale_spawn_slow:action=drop,count=2")
+    monkeypatch.setattr(chaos, "_env_loaded", False)
+    chaos.disarm()
+    try:
+        assert chaos.fire("drain_stall", key="replica0")
+        assert not chaos.fire("drain_stall", key="replica1")
+        assert chaos.fire("scale_spawn_slow", key="anything")
+        assert chaos.fire("scale_spawn_slow")
+        assert not chaos.fire("scale_spawn_slow")   # count=2 exhausted
+    finally:
+        chaos.disarm()
+
+
+# --------------------------------------------------------------------- #
+# Metric-name lint over the new families
+# --------------------------------------------------------------------- #
+def test_metrics_lint_catches_elastic_typos(tmp_path):
+    """Seeded typos BREAK the family prefix — a suffix typo under a
+    declared ``fleet/brownout_*`` family is legal by design (families
+    are open), so the lint's teeth are at the prefix."""
+    from deepspeed_tpu.analysis.metrics_lint import run_metrics_lint
+
+    src = textwrap.dedent("""
+        def export(m, k):
+            m.write("fleet/brownout_stage", 1)     # declared: clean
+            m.write("fleet/brownut_stage", 2)      # typo'd family prefix
+            m.write(f"fleet/brownout_{k}", 3)      # declared family: clean
+            m.write("fleet/scale_spawn_failed", 4) # declared: clean
+            m.write(f"fleet/scael_{k}", 5)         # typo'd family prefix
+    """)
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    findings = run_metrics_lint([str(p)])
+    assert len(findings) == 2, findings
+    assert all(f.rule == "metric-name" for f in findings)
+    msgs = " | ".join(f.message for f in findings)
+    assert "fleet/brownut_stage" in msgs and "fleet/scael_" in msgs
+
+
+def test_metrics_declarations_include_elastic_families():
+    from deepspeed_tpu.analysis.metrics_lint import declared_specs
+
+    names = {s.name for s in declared_specs()}
+    assert {"fleet/brownout_stage", "fleet/brownout_pressure",
+            "fleet/brownout_transitions", "fleet/brownout_held",
+            "fleet/brownout_*", "fleet/scale_*",
+            "fleet/scale_spawn_failed",
+            "fleet/scale_drain_escalations"} <= names
+
+
+# --------------------------------------------------------------------- #
+# The tier-1 elastic soak: real scale events under traffic, graceful
+# drain, brownout under spawn_fail, SIGKILL mid-drain, deadline-through-
+# gateway — behind a HARD timeout so an elastic bug can't hang CI.
+# --------------------------------------------------------------------- #
+def test_elastic_smoke_tool():
+    proc = subprocess.run(
+        [sys.executable, str(_TOOL)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=340)
+    assert proc.returncode == 0, \
+        f"stdout:\n{proc.stdout[-3000:]}\nstderr:\n{proc.stderr[-3000:]}"
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith('{"elastic_smoke"')]
+    assert lines, proc.stdout[-2000:]
+    snap = json.loads(lines[-1])
+    assert snap["elastic_smoke"] == "ok"
+    # the acceptance floor: >= 2 REAL scale-ups and scale-downs each
+    assert snap["soak_scale_ups"] + snap["subprocess_scale_ups"] >= 2
+    assert snap["soak_scale_downs"] + snap["subprocess_scale_downs"] >= 2
+    # graceful downsizes migrate, the SIGKILLed drain journal-replays
+    assert snap["subprocess_graceful_migrated"] == 0
+    assert snap["subprocess_kill_replays"] >= 1
+    # brownout engaged under the peak and under spawn_fail
+    assert snap["soak_brownout_max_stage"] >= 1
+    assert snap["spawn_fail_brownout_max_stage"] >= 2
+    assert snap["spawn_fail_breaker_opens"] >= 1
+    # live SSE streams survived the forced scale events
+    assert snap["streams"] == 3 and snap["streams_handoffs"] >= 1

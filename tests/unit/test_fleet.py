@@ -455,6 +455,35 @@ def test_fleet_autoscaler_integration_scales_up_under_backlog(params):
     assert all(fr.state == "finished" for fr in fleet.requests)
 
 
+def test_fleet_drain_stall_escalates_to_handoff(params, gold):
+    """A downsize victim that stops making drain progress (``drain_stall``
+    chaos, ``drop`` = the drain step is suppressed) is escalated at the
+    drain deadline: leftovers hand off to survivors, nothing is lost."""
+    fleet = ServingFleet(lambda name: _sched(params), replicas=2)
+    samp = SamplingParams(greedy=True, max_new_tokens=GEN)
+    frs = [fleet.submit(p, sampling=samp) for p in _prompts()]
+    fleet.step()
+    with chaos.inject("drain_stall", "drop", count=0):
+        fleet.set_replica_count(1, drain_deadline_s=0.2)
+    snap = fleet.snapshot()
+    assert snap["fleet/scale_drain_escalations"] == 1.0
+    assert snap["fleet/scale_down_drain_s"] >= 0.2
+    fleet.run_until_idle(max_ticks=300)
+    for i, fr in enumerate(frs):
+        assert fr.state == "finished" and fr.tokens == gold[i], (i, fr)
+    assert all(fr.replays == 0 for fr in frs)   # handoff, not replay
+
+
+def test_fleet_scale_spawn_slow_records_latency(params):
+    fleet = ServingFleet(lambda name: _sched(params), replicas=1)
+    with chaos.inject("scale_spawn_slow", sleep_s=0.15, count=0):
+        fleet.set_replica_count(2)
+    assert len(fleet.replica_names) == 2
+    snap = fleet.snapshot()
+    assert snap["fleet/scale_ups"] == 1.0
+    assert snap["fleet/scale_up_spawn_s"] >= 0.15
+
+
 # --------------------------------------------------------------------- #
 # Telemetry + router elasticity plumbing
 # --------------------------------------------------------------------- #

@@ -67,6 +67,15 @@ KNOWN_RECORD_SPECS: Dict[str, List[Tuple[str, str]]] = {
     # by starving throughput, both trip
     "serving_gateway_replay_goodput_tokens_per_sec": [
         ("value", "higher"), ("extra.interactive_p95_ttft_ms", "lower")],
+    # elastic diurnal soak (tools/elastic_smoke.py, matrix row
+    # serving_elastic_soak): goodput under the diurnal swing gates
+    # higher, the protected class's p95 TTFT gates lower, and the
+    # lost-request count gates lower (it must stay 0 — a scale event
+    # that loses even one request is a correctness regression, not a
+    # perf tradeoff)
+    "serving_elastic_soak_goodput_tokens_per_s": [
+        ("value", "higher"), ("extra.interactive_p95_ttft_ms", "lower"),
+        ("extra.lost_requests", "lower")],
     # paired-vs-folded attention microbench (bench.py --paired-ab):
     # the paired arm's step time AND its ratio against the interleaved
     # folded arm both gate lower — a kernel change that slows the

@@ -153,6 +153,22 @@ ROWS: List[MatrixRow] = [
                            "with the next TPU driver round, replaying "
                            "a chip-recorded trace against a real fleet"),
     MatrixRow(
+        name="serving_elastic_soak",
+        milestone="ROADMAP: live elastic capacity (real scale events "
+                  "under traffic, graceful-drain downsize, brownout "
+                  "degradation ladder)",
+        metric="serving_elastic_soak_goodput_tokens_per_s",
+        argv=["tools/elastic_smoke.py"],
+        cpu_ok=True,
+        timeout_s=600.0,
+        unavailable_reason="diurnal-soak goodput on CPU-host tiny-Llama "
+                           "measures the elastic machinery, not serving "
+                           "capacity — PERFLOG round 21 carries the "
+                           "measured scale-event latencies; the row "
+                           "goes live (drop this reason) with the next "
+                           "TPU driver round, soaking a chip-sized "
+                           "fleet through real diurnal load"),
+    MatrixRow(
         name="moe_mixtral_8x7b",
         milestone="BASELINE: DeepSpeed-MoE Mixtral-8x7B expert-parallel "
                   "all-to-all over ICI",
